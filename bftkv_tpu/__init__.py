@@ -1,0 +1,24 @@
+"""bftkv_tpu — a TPU-native Byzantine fault-tolerant distributed key-value
+framework with the capabilities of yahoo/bftkv.
+
+Capability parity with the reference (see SURVEY.md for the full map):
+
+- b-masking Byzantine quorum systems selected from a Web-of-Trust graph
+  (reference: quorum/wotqs/wotqs.go, node/graph/graph.go)
+- quorum-certificate signed writes with equivocation detection,
+  revoke-on-read and read-repair (reference: protocol/client.go,
+  protocol/server.go)
+- threshold password authentication (reference: crypto/auth/auth.go)
+- threshold RSA/DSA/ECDSA signing for a decentralized CA
+  (reference: crypto/threshold/)
+
+The crypto data plane is array-oriented from the ground up: signatures,
+public keys and shares live as fixed-limb uint32 arrays shaped
+``(batch, limbs)`` and every verify/sign/combine is a batched JAX/Pallas
+kernel (``bftkv_tpu.ops``), dispatched through a batching sidecar
+(``bftkv_tpu.parallel``) and sharded over a ``jax.sharding.Mesh``.
+"""
+
+__version__ = "0.1.0"
+
+from bftkv_tpu.errors import Error  # noqa: F401

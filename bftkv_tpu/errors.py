@@ -1,0 +1,138 @@
+"""Process-wide interned error registry.
+
+Errors travel between client and server as strings (the transport tunnels
+them in an ``x-error`` header) and must map back to the *identical* error
+value on the far side so protocol code can compare and count them.
+Capability parity with the reference's interned error map
+(reference: bftkv.go:12-48), done the Python way: each error is a distinct
+``Error`` *subclass* interned by message. That makes all of these work:
+
+- ``raise ERR_BAD_TIMESTAMP`` — raises a fresh instance (no shared
+  traceback state between concurrent raises);
+- ``except ERR_BAD_TIMESTAMP:`` — catch a specific error;
+- ``except Error as e:`` — catch any protocol error; ``e`` compares equal
+  to the interned value and to any error with the same message, so errors
+  can key dicts for majority-vote counting
+  (reference: protocol/client.go:28-50).
+"""
+
+from __future__ import annotations
+
+import threading
+
+
+def _message_of(obj: object) -> str | None:
+    m = getattr(obj, "message", None)
+    return m if isinstance(m, str) else None
+
+
+class _ErrorMeta(type):
+    """Make error *classes* compare/hash by message, so a class, an
+    instance of it, and a re-parsed wire error are all interchangeable."""
+
+    def __eq__(cls, other: object) -> bool:
+        other_m = _message_of(other)
+        return other_m is not None and other_m == cls.message
+
+    def __ne__(cls, other: object) -> bool:
+        return not cls.__eq__(other)
+
+    def __hash__(cls) -> int:
+        return hash(cls.message)
+
+    def __repr__(cls) -> str:  # pragma: no cover
+        return f"Error({cls.message!r})"
+
+
+class Error(Exception, metaclass=_ErrorMeta):
+    """Base class for all bftkv_tpu errors."""
+
+    message: str = "error"
+
+    def __init__(self, message: str | None = None):
+        if message is not None:
+            self.message = message
+        super().__init__(self.message)
+
+    def __eq__(self, other: object) -> bool:
+        other_m = _message_of(other)
+        return other_m is not None and other_m == self.message
+
+    def __ne__(self, other: object) -> bool:
+        return not self.__eq__(other)
+
+    def __hash__(self) -> int:
+        return hash(self.message)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Error({self.message!r})"
+
+
+_registry: dict[str, type[Error]] = {}
+_lock = threading.Lock()
+
+
+def new_error(message: str) -> type[Error]:
+    """Create (or fetch) the interned error class for ``message``."""
+    with _lock:
+        err = _registry.get(message)
+        if err is None:
+            name = "Err_" + "".join(
+                c if c.isalnum() else "_" for c in message
+            )
+            err = _ErrorMeta(name, (Error,), {"message": message})
+            _registry[message] = err
+        return err
+
+
+def error_from_string(message: str) -> type[Error]:
+    """Map a wire string back to the interned error value
+    (reference: bftkv.go:40-48)."""
+    return new_error(message)
+
+
+# The shared error vocabulary (reference: bftkv.go:12-29).
+ERR_INSUFFICIENT_NUMBER_OF_QUORUM = new_error("insufficient number of quorum")
+ERR_INSUFFICIENT_NUMBER_OF_RESPONSES = new_error("insufficient number of responses")
+ERR_INSUFFICIENT_NUMBER_OF_VALID_RESPONSES = new_error(
+    "insufficient number of valid responses"
+)
+ERR_INVALID_QUORUM_CERTIFICATE = new_error("invalid quorum certificate")
+ERR_INVALID_TIMESTAMP = new_error("invalid timestamp")
+ERR_INVALID_SIGN_REQUEST = new_error("invalid signature request")
+ERR_PERMISSION_DENIED = new_error("permission denied")
+ERR_BAD_TIMESTAMP = new_error("bad timestamp")
+ERR_EQUIVOCATION = new_error("equivocation error")
+ERR_INVALID_VARIABLE = new_error("invalid variable")
+ERR_UNKNOWN_COMMAND = new_error("unknown command")
+ERR_MALFORMED_REQUEST = new_error("malformed request")
+ERR_NO_MORE_WRITE = new_error("no more write")
+ERR_AUTHENTICATION_FAILURE = new_error("authentication failure")
+ERR_EXIST = new_error("already exist")
+ERR_INVALID_USER_ID = new_error("invalid user ID")
+ERR_INVALID_RESPONSE = new_error("invalid response")
+
+# Crypto-layer errors (reference: crypto/crypto.go:16-33).
+ERR_CERTIFICATE_NOT_FOUND = new_error("certificate not found")
+ERR_KEY_NOT_FOUND = new_error("key not found")
+ERR_INVALID_SIGNATURE = new_error("invalid signature")
+ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES = new_error(
+    "insufficient number of signatures"
+)
+ERR_INVALID_TRANSPORT_SECURITY_DATA = new_error(
+    "invalid transport security data"
+)
+ERR_NO_AUTHENTICATION_DATA = new_error("no authentication data")
+ERR_INVALID_AUTHENTICATION_DATA = new_error("invalid authentication data")
+ERR_TOO_MANY_ATTEMPTS = new_error("too many authentication attempts")
+ERR_UNSUPPORTED_ALGORITHM = new_error("unsupported algorithm")
+ERR_SHARE_NOT_FOUND = new_error("share not found")
+ERR_CONTINUE = new_error("continue")  # threshold phase loop sentinel
+ERR_DECRYPTION_FAILURE = new_error("decryption failure")
+
+# Storage errors (reference: storage/storage.go).
+ERR_NOT_FOUND = new_error("not found")
+
+# Transport errors.
+ERR_TRANSPORT = new_error("transport failure")
+ERR_NONCE_MISMATCH = new_error("nonce mismatch")

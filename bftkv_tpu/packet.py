@@ -1,0 +1,270 @@
+"""Binary wire format for the versioned triple ``<x, v, t, sig, ss, auth>``.
+
+Byte-compatible with the reference codec (reference: packet/packet.go:35-115)
+so captured traffic and fixtures are portable:
+
+- chunks are length-prefixed with a big-endian uint64; a zero-length chunk
+  parses back as ``None``;
+- the timestamp ``t`` is a big-endian uint64; ``t == 2**64 - 1`` marks a
+  write-once value (reference: protocol/client.go:90-92);
+- a signature packet is ``type(1) | version(4, BE) | completed(1) |
+  chunk(data) | chunk(cert)``; type 0 parses back as ``None``
+  (reference: packet/packet.go:192-235);
+- ``tbs(pkt)`` is the prefix up to and including ``t`` (what the writer
+  signs); ``tbss(pkt)`` additionally covers ``sig`` (what quorum members
+  collectively sign) (reference: packet/packet.go:142-190).
+
+Trailing fields may be omitted: a packet may stop after ``x``, after
+``v``, after ``t``, etc., and the parser returns ``None``/``0`` defaults
+for the rest — the protocol layer relies on this for short packets such
+as Time requests.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+from dataclasses import dataclass, field
+
+from bftkv_tpu.errors import ERR_MALFORMED_REQUEST
+
+SIGNATURE_TYPE_NIL = 0
+SIGNATURE_TYPE_NATIVE = 1  # our compact cert/signature format
+# One byte on the wire (the reference's 256 constant never fits its own
+# byte-typed field; we assign a real byte value instead).
+SIGNATURE_TYPE_PASSWORD_AUTH_PROOF = 2
+
+WRITE_ONCE_T = 2**64 - 1
+
+
+@dataclass
+class SignaturePacket:
+    """A writer or collective signature (reference: packet/packet.go:25-31)."""
+
+    type: int = SIGNATURE_TYPE_NATIVE
+    version: int = 0
+    completed: bool = True
+    data: bytes | None = None
+    cert: bytes | None = None
+
+
+def write_chunk(buf: io.BytesIO, chunk: bytes | None) -> None:
+    chunk = chunk or b""
+    buf.write(struct.pack(">Q", len(chunk)))
+    buf.write(chunk)
+
+
+def _read_u64(r: io.BytesIO) -> int:
+    """Read a big-endian uint64; EOFError at a clean boundary, protocol
+    error on a torn header."""
+    hdr = r.read(8)
+    if len(hdr) == 0:
+        raise EOFError
+    if len(hdr) < 8:
+        raise ERR_MALFORMED_REQUEST
+    return struct.unpack(">Q", hdr)[0]
+
+
+def read_chunk(r: io.BytesIO) -> bytes | None:
+    length = _read_u64(r)
+    if length == 0:
+        return None
+    # Bound-check before read: a hostile 2^63-scale prefix must be a clean
+    # protocol error, not an OverflowError out of BytesIO.
+    if length > len(r.getbuffer()) - r.tell():
+        raise ERR_MALFORMED_REQUEST
+    return r.read(length)
+
+
+def _write_signature(buf: io.BytesIO, sig: SignaturePacket | None) -> None:
+    if sig is None:
+        sig = SignaturePacket(type=SIGNATURE_TYPE_NIL, completed=False)
+    if not 0 <= sig.type <= 0xFF:
+        raise ValueError(f"signature type {sig.type} does not fit one byte")
+    buf.write(struct.pack(">BI?", sig.type, sig.version, sig.completed))
+    write_chunk(buf, sig.data)
+    write_chunk(buf, sig.cert)
+
+
+def _read_signature(r: io.BytesIO) -> SignaturePacket | None:
+    hdr = r.read(6)
+    if len(hdr) == 0:
+        raise EOFError
+    if len(hdr) < 6:
+        raise ERR_MALFORMED_REQUEST
+    typ, version, completed = struct.unpack(">BI?", hdr)
+    data = read_chunk(r)
+    cert = read_chunk(r)
+    if typ == SIGNATURE_TYPE_NIL:
+        return None
+    return SignaturePacket(
+        type=typ, version=version, completed=completed, data=data, cert=cert
+    )
+
+
+def serialize(
+    variable: bytes,
+    value: bytes | None = None,
+    t: int | None = None,
+    sig: SignaturePacket | None = None,
+    ss: SignaturePacket | None = None,
+    auth: bytes | None = None,
+    *,
+    nfields: int | None = None,
+) -> bytes:
+    """Serialize ``<x, v, t, sig, ss, auth>`` (reference: packet/packet.go:35-60).
+
+    ``nfields`` limits how many leading fields are emitted (default: all six),
+    mirroring the reference's variadic ``Serialize(x)``, ``Serialize(x, v)``,
+    ... call shapes.
+    """
+    if nfields is None:
+        nfields = 6
+    buf = io.BytesIO()
+    if nfields >= 1:
+        write_chunk(buf, variable)
+    if nfields >= 2:
+        write_chunk(buf, value)
+    if nfields >= 3:
+        buf.write(struct.pack(">Q", t or 0))
+    if nfields >= 4:
+        _write_signature(buf, sig)
+    if nfields >= 5:
+        _write_signature(buf, ss)
+    if nfields >= 6:
+        write_chunk(buf, auth)
+    return buf.getvalue()
+
+
+@dataclass
+class Packet:
+    """Parsed ``<x, v, t, sig, ss, auth>`` with defaults for omitted tails."""
+
+    variable: bytes | None = None
+    value: bytes | None = None
+    t: int = 0
+    sig: SignaturePacket | None = None
+    ss: SignaturePacket | None = None
+    auth: bytes | None = None
+
+    def serialize(self, nfields: int | None = None) -> bytes:
+        return serialize(
+            self.variable or b"",
+            self.value,
+            self.t,
+            self.sig,
+            self.ss,
+            self.auth,
+            nfields=nfields,
+        )
+
+
+def parse(pkt: bytes) -> Packet:
+    """Parse a packet, tolerating omitted *trailing* fields. EOF before the
+    first field is a malformed request — the reference only forgives EOF
+    after ``variable`` (reference: packet/packet.go:62-115)."""
+    r = io.BytesIO(pkt)
+    out = Packet()
+    try:
+        out.variable = read_chunk(r)
+    except EOFError:
+        raise ERR_MALFORMED_REQUEST from None
+    try:
+        out.value = read_chunk(r)
+        out.t = _read_u64(r)
+        out.sig = _read_signature(r)
+        out.ss = _read_signature(r)
+        out.auth = read_chunk(r)
+    except EOFError:
+        pass
+    return out
+
+
+def _tbs_offset(pkt: bytes) -> int:
+    """Offset just past ``t`` (reference: packet/packet.go:142-154)."""
+    r = io.BytesIO(pkt)
+    try:
+        for _ in range(2):  # variable, value
+            length = _read_u64(r)
+            if length > len(pkt) - r.tell():
+                raise ERR_MALFORMED_REQUEST
+            r.seek(length, io.SEEK_CUR)
+    except EOFError:
+        raise ERR_MALFORMED_REQUEST from None
+    r.seek(8, io.SEEK_CUR)  # timestamp
+    off = r.tell()
+    if off > len(pkt):
+        raise ERR_MALFORMED_REQUEST
+    return off
+
+
+def tbs(pkt: bytes) -> bytes:
+    """Bytes covered by the writer signature (reference: packet/packet.go:156-168)."""
+    return pkt[: _tbs_offset(pkt)]
+
+
+def tbss(pkt: bytes) -> bytes:
+    """Bytes covered by the collective signature
+    (reference: packet/packet.go:170-190)."""
+    off = _tbs_offset(pkt)
+    r = io.BytesIO(pkt)
+    r.seek(off)
+    try:
+        _read_signature(r)
+    except EOFError:
+        raise ERR_MALFORMED_REQUEST from None
+    end = r.tell()
+    if end > len(pkt):
+        raise ERR_MALFORMED_REQUEST
+    return pkt[:end]
+
+
+def parse_signature(pkt: bytes) -> SignaturePacket | None:
+    try:
+        return _read_signature(io.BytesIO(pkt))
+    except EOFError:
+        raise ERR_MALFORMED_REQUEST from None
+
+
+def serialize_signature(sig: SignaturePacket | None) -> bytes:
+    buf = io.BytesIO()
+    _write_signature(buf, sig)
+    return buf.getvalue()
+
+
+def serialize_auth_request(phase: int, variable: bytes, adata: bytes) -> bytes:
+    """(reference: packet/packet.go:266-278)"""
+    buf = io.BytesIO()
+    buf.write(bytes([phase & 0xFF]))
+    write_chunk(buf, variable)
+    write_chunk(buf, adata)
+    return buf.getvalue()
+
+
+def parse_auth_request(pkt: bytes) -> tuple[int, bytes | None, bytes | None]:
+    """(reference: packet/packet.go:250-264)"""
+    r = io.BytesIO(pkt)
+    b = r.read(1)
+    if len(b) < 1:
+        raise ERR_MALFORMED_REQUEST
+    phase = b[0]
+    variable = read_chunk(r)
+    adata = read_chunk(r)
+    return phase, variable, adata
+
+
+def write_bigint(buf: io.BytesIO, n: int | None) -> None:
+    """(reference: packet/packet.go:288-294)"""
+    if n is None:
+        write_chunk(buf, b"")
+        return
+    if n < 0:
+        raise ValueError("write_bigint: negative")
+    length = (n.bit_length() + 7) // 8
+    write_chunk(buf, n.to_bytes(length, "big"))
+
+
+def read_bigint(r: io.BytesIO) -> int:
+    """(reference: packet/packet.go:280-286)"""
+    c = read_chunk(r)
+    return int.from_bytes(c or b"", "big")
