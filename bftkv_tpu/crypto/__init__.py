@@ -1,0 +1,9 @@
+"""bftkv_tpu.crypto — the crypto capability seams.
+
+Mirrors the reference's interface bundle (crypto/crypto.go:35-111):
+keyring, certificate, signature, message security, collective signature,
+data encryption, RNG, plus the threshold-crypto interfaces. The concrete
+implementation (``bftkv_tpu.crypto.native``) replaces the reference's PGP
+stack with a compact certificate format whose hot-path math runs as
+batched TPU kernels (``bftkv_tpu.ops``).
+"""

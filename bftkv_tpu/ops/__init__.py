@@ -1,0 +1,15 @@
+"""bftkv_tpu.ops — batched TPU kernels for the crypto data plane.
+
+The reference's hot loops (SURVEY.md §2 "hot crypto loops") are per-item
+``math/big`` modexps and per-signature PGP verifies. Here they are
+array programs: big integers are ``(batch, limbs)`` arrays of 16-bit
+digits, and every sign/verify/combine is a batched, jit-compiled kernel.
+
+Modules:
+- ``limb``   — host-side codec between Python ints and limb arrays
+- ``bigint`` — batched limb arithmetic: mul, Montgomery REDC, modexp
+- ``ec``     — batched P-256 point arithmetic (Jacobian), scalar mult
+- ``tally``  — vmapped quorum/graph boolean reductions
+"""
+
+from bftkv_tpu.ops import bigint, limb  # noqa: F401

@@ -1,0 +1,304 @@
+"""Batched big-integer arithmetic as JAX array programs.
+
+This is the TPU re-design of the reference's per-item ``math/big`` hot
+loops (``big.Int.Exp`` in crypto/auth/auth.go, crypto/threshold/rsa/rsa.go,
+and RSA verify inside ``openpgp.CheckDetachedSignature`` — SURVEY.md §2).
+Numbers are ``(batch, L)`` uint32 arrays of 16-bit digits (see
+``bftkv_tpu.ops.limb``); every operation below is shape-static, branch-free
+and batch-leading, so it jits once and vmaps/shards over the batch axis.
+
+Design notes (TPU-first, no transliteration):
+
+- digit products of 16-bit limbs are exact in uint32; column sums are kept
+  exact by a lo/hi split (each partial sum stays under 2^24 for L ≤ 256);
+- carry propagation is *parallel*: two local passes reduce lane values to
+  digit + {0,1} carry, then a Kogge–Stone generate/propagate
+  ``lax.associative_scan`` resolves the remaining ripple in O(log L) — no
+  sequential limb loop anywhere;
+- multiplication is a gather-based Toeplitz product: ``b`` is gathered
+  into anti-diagonal alignment once, then the whole digit-product tensor
+  reduces along one axis — XLA fuses this into a single pass;
+- modular arithmetic is Montgomery form (REDC with R = 2^(16·L));
+  exponentiation is fixed-4-bit-window with constant-time table gathers
+  under ``lax.fori_loop`` (uniform schedule — SURVEY.md §7 hard part #3).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bftkv_tpu.ops import limb as limb_codec
+from bftkv_tpu.ops.limb import LIMB_BITS, LIMB_MASK
+
+__all__ = [
+    "MontgomeryDomain",
+    "add",
+    "carry_resolve",
+    "geq",
+    "mont_exp",
+    "mont_mul",
+    "mont_pow_static",
+    "mul",
+    "sub_mod_r",
+]
+
+
+def _shift_up(x: jnp.ndarray) -> jnp.ndarray:
+    """Multiply by the limb base: out[..., k] = x[..., k-1], out[..., 0] = 0."""
+    pad = [(0, 0)] * (x.ndim - 1) + [(1, 0)]
+    return jnp.pad(x, pad)[..., :-1]
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def carry_resolve(x: jnp.ndarray, out_len: int) -> jnp.ndarray:
+    """Resolve lane values (< 2^32) into canonical 16-bit digits.
+
+    The represented value Σ x_k·2^(16k) must fit in ``out_len`` digits.
+    Two local passes bound each lane's outstanding carry to one bit, then a
+    generate/propagate associative scan finishes the ripple in log time.
+    """
+    k = x.shape[-1]
+    w = max(out_len, k) + 1
+    x = jnp.pad(x.astype(jnp.uint32), [(0, 0)] * (x.ndim - 1) + [(0, w - k)])
+    # Pass 1: split digit/carry (carry ≤ 2^16-1).
+    e = (x & LIMB_MASK) + _shift_up(x >> LIMB_BITS)  # < 2^17
+    # Pass 2: now carries are single bits.
+    t = (e & LIMB_MASK) + _shift_up(e >> LIMB_BITS)  # ≤ 2^16
+    r = t & LIMB_MASK
+    g = (t >> LIMB_BITS).astype(jnp.bool_)  # generate
+    p = r == LIMB_MASK  # propagate
+
+    def comb(lo, hi):
+        glo, plo = lo
+        ghi, phi = hi
+        return ghi | (phi & glo), plo & phi
+
+    gg, _ = lax.associative_scan(comb, (g, p), axis=-1)
+    carry_in = _shift_up(gg.astype(jnp.uint32))
+    out = (r + carry_in) & LIMB_MASK
+    return out[..., :out_len]
+
+
+@functools.lru_cache(maxsize=None)
+def _toeplitz_index(nl: int, ncols: int) -> tuple[np.ndarray, np.ndarray]:
+    """idx[i, k] = k - i (clipped), mask[i, k] = 0 ≤ k - i < nl."""
+    i = np.arange(nl)[:, None]
+    k = np.arange(ncols)[None, :]
+    d = k - i
+    mask = (d >= 0) & (d < nl)
+    return np.clip(d, 0, nl - 1).astype(np.int32), mask
+
+
+def _mul_cols(a: jnp.ndarray, b: jnp.ndarray, ncols: int) -> jnp.ndarray:
+    """Unresolved column sums of a·b, first ``ncols`` digit positions."""
+    nl = a.shape[-1]
+    idx, mask = _toeplitz_index(nl, ncols)
+    bg = jnp.where(mask, b[..., idx], 0)  # (..., nl, ncols)
+    p = a[..., :, None] * bg  # exact uint32 products of 16-bit digits
+    lo = (p & LIMB_MASK).sum(axis=-2)  # ≤ nl·(2^16-1) < 2^24 for nl ≤ 256
+    hi = (p >> LIMB_BITS).sum(axis=-2)
+    return lo + _shift_up(hi)  # < 2^25
+
+
+@jax.jit
+def mul(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Full product, ``(..., L) × (..., L) → (..., 2L)``."""
+    nl = a.shape[-1]
+    return carry_resolve(_mul_cols(a, b, 2 * nl), 2 * nl)
+
+
+def _mul_lo(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Low half of the product (mod R), ``(..., L) → (..., L)``."""
+    nl = a.shape[-1]
+    return carry_resolve(_mul_cols(a, b, nl), nl)
+
+
+@functools.partial(jax.jit, static_argnums=2)
+def add(a: jnp.ndarray, b: jnp.ndarray, out_len: int) -> jnp.ndarray:
+    """a + b into ``out_len`` digits (must fit)."""
+    w = max(a.shape[-1], b.shape[-1])
+
+    def ext(x):
+        return jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, w - x.shape[-1])])
+
+    return carry_resolve(ext(a) + ext(b), out_len)
+
+
+@jax.jit
+def sub_mod_r(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(a - b) mod R over the common digit width (two's-complement add)."""
+    comp = (LIMB_MASK - jnp.asarray(b)).astype(jnp.uint32)
+    s = jnp.asarray(a) + comp
+    s = s.at[..., 0].add(1)
+    return carry_resolve(s, a.shape[-1])
+
+
+@jax.jit
+def geq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Lexicographic a ≥ b over the last axis; returns (...,) bool."""
+    ne = a != b
+    # Highest differing digit (0 if all equal — then a == b there, so ≥).
+    rev_arg = jnp.argmax(ne[..., ::-1], axis=-1)
+    idx = a.shape[-1] - 1 - rev_arg
+    at = jnp.take_along_axis(a, idx[..., None], axis=-1)[..., 0]
+    bt = jnp.take_along_axis(b, idx[..., None], axis=-1)[..., 0]
+    return at >= bt
+
+
+def _cond_sub(t: jnp.ndarray, n: jnp.ndarray, hi: jnp.ndarray) -> jnp.ndarray:
+    """t (+ hi·R) − n if that quantity is ≥ 0 and t < 2n; else t. L digits."""
+    need = hi.astype(jnp.bool_) | geq(t, n)
+    return jnp.where(need[..., None], sub_mod_r(t, n), t)
+
+
+@jax.jit
+def mont_mul(
+    a: jnp.ndarray, b: jnp.ndarray, n: jnp.ndarray, n_prime: jnp.ndarray
+) -> jnp.ndarray:
+    """Montgomery product abR⁻¹ mod n (REDC). All inputs < n, L digits."""
+    nl = a.shape[-1]
+    t_cols = _mul_cols(a, b, 2 * nl)  # unresolved T = a·b
+    t_lo = carry_resolve(t_cols[..., :nl], nl)  # T mod R (low half exact)
+    m = _mul_lo(t_lo, jnp.broadcast_to(n_prime, t_lo.shape))
+    mn_cols = _mul_cols(m, jnp.broadcast_to(n, m.shape), 2 * nl)
+    # (T + m·n) / R: sum the unresolved columns, resolve into 2L+1 digits.
+    s = carry_resolve(t_cols + mn_cols, 2 * nl + 1)  # sums < 2^26: exact
+    t = s[..., nl : 2 * nl]
+    hi = s[..., 2 * nl]
+    return _cond_sub(t, jnp.broadcast_to(n, t.shape), hi)
+
+
+@jax.jit
+def to_mont(
+    x: jnp.ndarray, r2: jnp.ndarray, n: jnp.ndarray, n_prime: jnp.ndarray
+) -> jnp.ndarray:
+    return mont_mul(x, jnp.broadcast_to(r2, x.shape), n, n_prime)
+
+
+@jax.jit
+def from_mont(x: jnp.ndarray, n: jnp.ndarray, n_prime: jnp.ndarray) -> jnp.ndarray:
+    one = jnp.zeros_like(x).at[..., 0].set(1)
+    return mont_mul(x, one, n, n_prime)
+
+
+@functools.partial(jax.jit, static_argnums=1)
+def mont_pow_static(
+    a_mont: jnp.ndarray,
+    e: int,
+    n: jnp.ndarray,
+    n_prime: jnp.ndarray,
+) -> jnp.ndarray:
+    """a^e in Montgomery form for a *static public* exponent (e.g. 65537).
+
+    The square-and-multiply chain unrolls at trace time — RSA verify with
+    e = 65537 is 17 Montgomery products, the ideal TPU case (SURVEY.md §7).
+    """
+    if e <= 0:
+        raise ValueError("mont_pow_static: exponent must be positive")
+    acc = a_mont
+    for bit in bin(e)[3:]:  # skip leading 1
+        acc = mont_mul(acc, acc, n, n_prime)
+        if bit == "1":
+            acc = mont_mul(acc, a_mont, n, n_prime)
+    return acc
+
+
+_WINDOW = 4
+
+
+@jax.jit
+def mont_exp(
+    a_mont: jnp.ndarray,
+    e: jnp.ndarray,
+    n: jnp.ndarray,
+    n_prime: jnp.ndarray,
+    one_mont: jnp.ndarray,
+) -> jnp.ndarray:
+    """a^e in Montgomery form; ``e`` is a per-element (or shared) limb array.
+
+    Fixed 4-bit windows with constant-time table gathers: a uniform
+    schedule of 4 squarings + 1 table-select product per window, identical
+    across the batch — no data-dependent control flow, so the whole loop
+    compiles to one fused XLA while-region.
+    """
+    a_mont, n, n_prime, one_mont = jnp.broadcast_arrays(a_mont, n, n_prime, one_mont)
+    e = jnp.asarray(e, dtype=jnp.uint32)
+    if e.ndim < a_mont.ndim:
+        e = jnp.broadcast_to(e, a_mont.shape[:-1] + e.shape[-1:])
+    e_limbs = e.shape[-1]
+    nwin = e_limbs * (LIMB_BITS // _WINDOW)
+
+    # Power table t[j] = a^j·R mod n for j in [0, 16), shape (..., 16, L).
+    def step(prev, _):
+        nxt = mont_mul(prev, a_mont, n, n_prime)
+        return nxt, nxt
+
+    _, powers = lax.scan(step, one_mont, None, length=15)
+    # scan stacks on axis 0: (15, ..., L) → (..., 16, L)
+    powers = jnp.moveaxis(powers, 0, -2)
+    table = jnp.concatenate([one_mont[..., None, :], powers], axis=-2)
+
+    def body(j, acc):
+        # Window j counts from the most significant end.
+        widx = nwin - 1 - j
+        limb_idx = widx // (LIMB_BITS // _WINDOW)
+        shift = (widx % (LIMB_BITS // _WINDOW)) * _WINDOW
+        wv = (
+            jnp.take_along_axis(
+                e, jnp.broadcast_to(limb_idx, e.shape[:-1])[..., None], axis=-1
+            )[..., 0]
+            >> shift
+        ) & (2**_WINDOW - 1)
+        for _ in range(_WINDOW):
+            acc = mont_mul(acc, acc, n, n_prime)
+        sel = jnp.take_along_axis(
+            table, wv[..., None, None].astype(jnp.int32), axis=-2
+        )[..., 0, :]
+        return mont_mul(acc, sel, n, n_prime)
+
+    return lax.fori_loop(0, nwin, body, one_mont)
+
+
+class MontgomeryDomain:
+    """Host-side precomputation for one odd modulus.
+
+    Holds ``n``, ``n' = -n⁻¹ mod R`` and ``R² mod n`` as limb arrays ready
+    to broadcast against ``(batch, L)`` operands. Stack several with
+    ``np.stack`` for per-element moduli.
+    """
+
+    def __init__(self, n: int, nlimbs: int | None = None):
+        if n % 2 == 0:
+            raise ValueError("Montgomery modulus must be odd")
+        if nlimbs is None:
+            nlimbs = limb_codec.nlimbs_for_bits(n.bit_length())
+        self.n_int = n
+        self.nlimbs = nlimbs
+        r = 1 << (LIMB_BITS * nlimbs)
+        if n >= r:
+            raise ValueError("modulus does not fit limb count")
+        self.r_int = r
+        n_prime = (-pow(n, -1, r)) % r
+        r2 = (r * r) % n
+        self.n = limb_codec.int_to_limbs(n, nlimbs)
+        self.n_prime = limb_codec.int_to_limbs(n_prime, nlimbs)
+        self.r2 = limb_codec.int_to_limbs(r2, nlimbs)
+        self.one_mont = limb_codec.int_to_limbs(r % n, nlimbs)
+
+    def encode(self, xs: list[int]) -> np.ndarray:
+        """ints → Montgomery-form limb batch (host-side, for setup paths)."""
+        return limb_codec.ints_to_limbs(
+            [(x * self.r_int) % self.n_int for x in xs], self.nlimbs
+        )
+
+    def decode(self, a) -> list[int]:
+        """Montgomery-form limb batch → ints (host-side)."""
+        return [
+            (x * pow(self.r_int, -1, self.n_int)) % self.n_int
+            for x in limb_codec.limbs_to_ints(np.asarray(a))
+        ]

@@ -24,7 +24,7 @@ from __future__ import annotations
 
 import io
 import struct
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from bftkv_tpu.errors import ERR_MALFORMED_REQUEST
 
@@ -248,8 +248,11 @@ def parse_auth_request(pkt: bytes) -> tuple[int, bytes | None, bytes | None]:
     if len(b) < 1:
         raise ERR_MALFORMED_REQUEST
     phase = b[0]
-    variable = read_chunk(r)
-    adata = read_chunk(r)
+    try:
+        variable = read_chunk(r)
+        adata = read_chunk(r)
+    except EOFError:
+        raise ERR_MALFORMED_REQUEST from None
     return phase, variable, adata
 
 
@@ -266,5 +269,8 @@ def write_bigint(buf: io.BytesIO, n: int | None) -> None:
 
 def read_bigint(r: io.BytesIO) -> int:
     """(reference: packet/packet.go:280-286)"""
-    c = read_chunk(r)
+    try:
+        c = read_chunk(r)
+    except EOFError:
+        raise ERR_MALFORMED_REQUEST from None
     return int.from_bytes(c or b"", "big")
