@@ -1,0 +1,43 @@
+"""Versioned key-value storage backends.
+
+Capability parity with the reference storage layer
+(reference: storage/storage.go:14-17): ``read(variable, t)`` with
+``t == 0`` meaning "the latest version", ``write(variable, t, value)``
+appending a version. Every version is retained — the store *is* the
+durable state of a replica (SURVEY.md §5 "Checkpoint / resume").
+
+Backends:
+
+- :class:`bftkv_tpu.storage.plain.PlainStorage` — one file per version
+  (reference: storage/plain/plain.go:22-90);
+- :class:`bftkv_tpu.storage.memkv.MemStorage` — in-process sorted map,
+  used by tests and simulated clusters;
+- :class:`bftkv_tpu.storage.native.NativeStorage` — C++ log-structured
+  engine (the leveldb-equivalent, reference: storage/leveldb/leveldb.go),
+  loaded via ctypes when the shared library has been built.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+from bftkv_tpu.errors import ERR_NOT_FOUND
+
+__all__ = ["Storage", "ERR_NOT_FOUND"]
+
+
+@runtime_checkable
+class Storage(Protocol):
+    """The storage interface (reference: storage/storage.go:14-17)."""
+
+    def read(self, variable: bytes, t: int = 0) -> bytes:
+        """Return the value at timestamp ``t``; ``t == 0`` means latest.
+
+        Raises ``ERR_NOT_FOUND`` if the variable (or that version) does
+        not exist.
+        """
+        ...
+
+    def write(self, variable: bytes, t: int, value: bytes) -> None:
+        """Store ``value`` as version ``t`` of ``variable``."""
+        ...

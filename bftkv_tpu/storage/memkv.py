@@ -1,0 +1,46 @@
+"""In-memory versioned KV store.
+
+The simulated-cluster backend: hundreds of replicas in one process each
+get an isolated ``MemStorage`` (the analog of the reference tests running
+one leveldb per key directory). Layout mirrors the leveldb backend's key
+order — per-variable versions kept sorted so "latest" is O(1)
+(reference: storage/leveldb/leveldb.go:30-46, prefix iterator ``Last()``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+from bftkv_tpu.errors import ERR_NOT_FOUND
+
+
+class MemStorage:
+    def __init__(self):
+        # variable -> (sorted list of t, {t: value})
+        self._data: dict[bytes, tuple[list[int], dict[int, bytes]]] = {}
+        self._lock = threading.Lock()
+
+    def read(self, variable: bytes, t: int = 0) -> bytes:
+        with self._lock:
+            entry = self._data.get(variable)
+            if entry is None:
+                raise ERR_NOT_FOUND
+            ts, values = entry
+            if t == 0:
+                t = ts[-1]
+            value = values.get(t)
+            if value is None:
+                raise ERR_NOT_FOUND
+            return value
+
+    def write(self, variable: bytes, t: int, value: bytes) -> None:
+        with self._lock:
+            entry = self._data.get(variable)
+            if entry is None:
+                entry = ([], {})
+                self._data[variable] = entry
+            ts, values = entry
+            if t not in values:
+                bisect.insort(ts, t)
+            values[t] = value

@@ -1,0 +1,111 @@
+"""ctypes binding for the C++ log-structured KV engine.
+
+The native analog of the reference's leveldb backend
+(reference: storage/leveldb/leveldb.go:22-53). The shared library is
+built on demand from ``native/kvstore.cpp`` (no pybind11 in the image;
+plain C ABI + ctypes).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from bftkv_tpu.errors import ERR_NOT_FOUND, new_error
+
+ERR_STORAGE_IO = new_error("storage I/O failure")
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libbftkvstore.so"))
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(
+                ["make", "-s"],
+                cwd=os.path.abspath(_NATIVE_DIR),
+                check=True,
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.kv_open.restype = ctypes.c_void_p
+        lib.kv_open.argtypes = [ctypes.c_char_p]
+        lib.kv_close.argtypes = [ctypes.c_void_p]
+        lib.kv_write.restype = ctypes.c_int
+        lib.kv_write.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.c_uint64,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
+        lib.kv_read.restype = ctypes.c_int64
+        lib.kv_read.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.c_uint64,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        _lib = lib
+        return lib
+
+
+class NativeStorage:
+    def __init__(self, path: str):
+        self._lib = _load()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        handle = self._lib.kv_open(path.encode())
+        if not handle:
+            raise ERR_STORAGE_IO
+        self._handle = handle
+        self._lock = threading.Lock()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._handle:
+                self._lib.kv_close(self._handle)
+                self._handle = None
+
+    def __del__(self):  # pragma: no cover - GC timing
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    def read(self, variable: bytes, t: int = 0) -> bytes:
+        with self._lock:
+            t_out = ctypes.c_uint64(0)
+            n = self._lib.kv_read(
+                self._handle, variable, len(variable), t, None, ctypes.byref(t_out)
+            )
+            if n == -1:
+                raise ERR_NOT_FOUND
+            if n < 0:
+                raise ERR_STORAGE_IO
+            buf = ctypes.create_string_buffer(int(n))
+            # Re-read pinned at the resolved timestamp so a concurrent
+            # write of a newer version between the two calls is harmless.
+            n2 = self._lib.kv_read(
+                self._handle, variable, len(variable), t_out.value, buf, None
+            )
+            if n2 < 0 or n2 != n:
+                raise ERR_STORAGE_IO
+            return buf.raw[: int(n)]
+
+    def write(self, variable: bytes, t: int, value: bytes) -> None:
+        with self._lock:
+            rc = self._lib.kv_write(
+                self._handle, variable, len(variable), t, value, len(value)
+            )
+            if rc != 0:
+                raise ERR_STORAGE_IO

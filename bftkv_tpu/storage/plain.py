@@ -1,0 +1,72 @@
+"""Plain-file storage: one file per version.
+
+File name is ``hex(variable).t`` inside the store directory; the latest
+version is found by scanning for the maximum ``t`` suffix
+(reference: storage/plain/plain.go:28-60). Writes are atomic
+(write-to-temp + rename) and the whole store is guarded by a lock the
+same way the reference serializes file I/O with a mutex
+(reference: storage/plain/plain.go:19).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+from bftkv_tpu.errors import ERR_NOT_FOUND
+
+
+class PlainStorage:
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        os.makedirs(path, exist_ok=True)
+
+    def _prefix(self, variable: bytes) -> str:
+        # hex(variable) as the file stem (reference: plain.go:28-33), but
+        # long variables would blow the 255-byte filename limit — hash them.
+        if len(variable) > 96:
+            import hashlib
+
+            return "h" + hashlib.sha256(variable).hexdigest()
+        return variable.hex()
+
+    def _latest_t(self, variable: bytes) -> int | None:
+        prefix = self._prefix(variable) + "."
+        best: int | None = None
+        try:
+            names = os.listdir(self.path)
+        except FileNotFoundError:
+            return None
+        for name in names:
+            if not name.startswith(prefix):
+                continue
+            try:
+                t = int(name[len(prefix) :])
+            except ValueError:
+                continue
+            if best is None or t > best:
+                best = t
+        return best
+
+    def read(self, variable: bytes, t: int = 0) -> bytes:
+        with self._lock:
+            if t == 0:
+                latest = self._latest_t(variable)
+                if latest is None:
+                    raise ERR_NOT_FOUND
+                t = latest
+            fn = os.path.join(self.path, f"{self._prefix(variable)}.{t}")
+            try:
+                with open(fn, "rb") as f:
+                    return f.read()
+            except FileNotFoundError:
+                raise ERR_NOT_FOUND from None
+
+    def write(self, variable: bytes, t: int, value: bytes) -> None:
+        with self._lock:
+            fn = os.path.join(self.path, f"{self._prefix(variable)}.{t}")
+            tmp = fn + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(value)
+            os.replace(tmp, fn)

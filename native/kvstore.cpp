@@ -1,0 +1,138 @@
+// Log-structured versioned KV engine — the native storage backend.
+//
+// Capability parity with the reference's leveldb backend
+// (reference: storage/leveldb/leveldb.go:22-53): key space is
+// variable || bigendian(t), "latest" is the maximal t for a variable,
+// writes are synced. Design is TPU-framework-native rather than a port:
+// a single append-only log with an in-memory version index, rebuilt by
+// replay on open — recovery therefore composes with the protocol layer's
+// rejoin + read-repair story (SURVEY.md §5 "Checkpoint / resume").
+//
+// C ABI for ctypes (no pybind11 in the image).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Slot {
+  uint64_t offset;  // offset of the value bytes in the log
+  uint64_t length;
+};
+
+// Record layout: magic(1) | varlen(u32 LE) | t(u64 LE) | vallen(u64 LE)
+// | var | val
+constexpr uint8_t kMagic = 0xB7;
+constexpr size_t kHeader = 1 + 4 + 8 + 8;
+
+struct Store {
+  FILE* log = nullptr;
+  std::string path;
+  std::mutex mu;
+  std::map<std::string, std::map<uint64_t, Slot>> index;
+  uint64_t tail = 0;
+
+  bool Replay() {
+    std::vector<char> hdr(kHeader);
+    uint64_t off = 0;
+    if (fseek(log, 0, SEEK_SET) != 0) return false;
+    for (;;) {
+      size_t got = fread(hdr.data(), 1, kHeader, log);
+      if (got == 0) break;           // clean end
+      if (got < kHeader) break;      // torn tail: truncate logically
+      if ((uint8_t)hdr[0] != kMagic) break;
+      uint32_t varlen;
+      uint64_t t, vallen;
+      memcpy(&varlen, hdr.data() + 1, 4);
+      memcpy(&t, hdr.data() + 5, 8);
+      memcpy(&vallen, hdr.data() + 13, 8);
+      std::string var(varlen, '\0');
+      if (fread(var.data(), 1, varlen, log) < varlen) break;
+      uint64_t val_off = off + kHeader + varlen;
+      if (fseek(log, (long)vallen, SEEK_CUR) != 0) break;
+      index[var][t] = Slot{val_off, vallen};
+      off = val_off + vallen;
+      if (fseek(log, (long)off, SEEK_SET) != 0) break;
+    }
+    tail = off;
+    return fseek(log, (long)tail, SEEK_SET) == 0;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+Store* kv_open(const char* path) {
+  FILE* f = fopen(path, "a+b");
+  if (!f) return nullptr;
+  Store* s = new Store;
+  s->log = f;
+  s->path = path;
+  if (!s->Replay()) {
+    fclose(f);
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void kv_close(Store* s) {
+  if (!s) return;
+  fclose(s->log);
+  delete s;
+}
+
+// Returns 0 on success.
+int kv_write(Store* s, const uint8_t* var, uint32_t varlen, uint64_t t,
+             const uint8_t* val, uint64_t vallen) {
+  std::lock_guard<std::mutex> lock(s->mu);
+  if (fseek(s->log, (long)s->tail, SEEK_SET) != 0) return -1;
+  uint8_t hdr[kHeader];
+  hdr[0] = kMagic;
+  memcpy(hdr + 1, &varlen, 4);
+  memcpy(hdr + 5, &t, 8);
+  memcpy(hdr + 13, &vallen, 8);
+  if (fwrite(hdr, 1, kHeader, s->log) < kHeader) return -1;
+  if (varlen && fwrite(var, 1, varlen, s->log) < varlen) return -1;
+  if (vallen && fwrite(val, 1, vallen, s->log) < vallen) return -1;
+  if (fflush(s->log) != 0) return -1;  // synced writes, leveldb.go:48-53
+  uint64_t val_off = s->tail + kHeader + varlen;
+  s->index[std::string((const char*)var, varlen)][t] = Slot{val_off, vallen};
+  s->tail = val_off + vallen;
+  return 0;
+}
+
+// t == 0 means latest. Returns value length, or -1 if not found, or -2 on
+// I/O error. If out is non-null it must have room for the value (call once
+// with out == nullptr to size, then again to fetch; *t_out gets the
+// resolved timestamp so the pair of calls is consistent).
+int64_t kv_read(Store* s, const uint8_t* var, uint32_t varlen, uint64_t t,
+                uint8_t* out, uint64_t* t_out) {
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->index.find(std::string((const char*)var, varlen));
+  if (it == s->index.end() || it->second.empty()) return -1;
+  const std::map<uint64_t, Slot>& versions = it->second;
+  std::map<uint64_t, Slot>::const_iterator vit;
+  if (t == 0) {
+    vit = std::prev(versions.end());
+  } else {
+    vit = versions.find(t);
+    if (vit == versions.end()) return -1;
+  }
+  if (t_out) *t_out = vit->first;
+  const Slot& slot = vit->second;
+  if (out) {
+    if (fseek(s->log, (long)slot.offset, SEEK_SET) != 0) return -2;
+    if (fread(out, 1, slot.length, s->log) < slot.length) return -2;
+    if (fseek(s->log, (long)s->tail, SEEK_SET) != 0) return -2;
+  }
+  return (int64_t)slot.length;
+}
+
+}  // extern "C"

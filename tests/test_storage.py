@@ -1,0 +1,96 @@
+"""Storage backends: versioned read/write, t=0=latest, persistence.
+
+Mirrors the semantics of the reference storage layer
+(reference: storage/storage.go, storage/plain/plain.go,
+storage/leveldb/leveldb.go).
+"""
+
+import pytest
+
+from bftkv_tpu.errors import ERR_NOT_FOUND
+from bftkv_tpu.storage.memkv import MemStorage
+from bftkv_tpu.storage.native import NativeStorage
+from bftkv_tpu.storage.plain import PlainStorage
+
+
+@pytest.fixture(params=["mem", "plain", "native"])
+def store(request, tmp_path):
+    if request.param == "mem":
+        yield MemStorage()
+    elif request.param == "plain":
+        yield PlainStorage(str(tmp_path / "db"))
+    else:
+        s = NativeStorage(str(tmp_path / "db.log"))
+        yield s
+        s.close()
+
+
+def test_not_found(store):
+    with pytest.raises(ERR_NOT_FOUND):
+        store.read(b"missing")
+    with pytest.raises(ERR_NOT_FOUND):
+        store.read(b"missing", 3)
+
+
+def test_versions_and_latest(store):
+    store.write(b"x", 1, b"v1")
+    store.write(b"x", 3, b"v3")
+    store.write(b"x", 2, b"v2")
+    assert store.read(b"x", 1) == b"v1"
+    assert store.read(b"x", 2) == b"v2"
+    assert store.read(b"x") == b"v3"  # t=0 -> latest
+    with pytest.raises(ERR_NOT_FOUND):
+        store.read(b"x", 4)
+
+
+def test_overwrite_same_t(store):
+    store.write(b"x", 5, b"a")
+    store.write(b"x", 5, b"b")
+    assert store.read(b"x", 5) == b"b"
+    assert store.read(b"x") == b"b"
+
+
+def test_empty_value_and_binary_keys(store):
+    var = bytes(range(256))
+    store.write(var, 1, b"")
+    assert store.read(var) == b""
+
+
+def test_writeonce_timestamp(store):
+    t = 2**64 - 1
+    store.write(b"once", t, b"final")
+    assert store.read(b"once") == b"final"
+    assert store.read(b"once", t) == b"final"
+
+
+@pytest.mark.parametrize("cls", ["plain", "native"])
+def test_persistence_across_reopen(cls, tmp_path):
+    if cls == "plain":
+        path = str(tmp_path / "db")
+        s = PlainStorage(path)
+    else:
+        path = str(tmp_path / "db.log")
+        s = NativeStorage(path)
+    s.write(b"x", 1, b"v1")
+    s.write(b"x", 2, b"v2")
+    s.write(b"y", 7, b"w")
+    if cls == "native":
+        s.close()
+        s = NativeStorage(path)
+    else:
+        s = PlainStorage(path)
+    assert s.read(b"x") == b"v2"
+    assert s.read(b"x", 1) == b"v1"
+    assert s.read(b"y") == b"w"
+    if cls == "native":
+        s.close()
+
+
+def test_native_large_values(tmp_path):
+    s = NativeStorage(str(tmp_path / "db.log"))
+    big = bytes(1024 * 1024)
+    s.write(b"big", 1, big)
+    s.write(b"big", 2, b"tiny")
+    assert s.read(b"big", 1) == big
+    assert s.read(b"big") == b"tiny"
+    s.close()
