@@ -1,0 +1,184 @@
+"""Compact certificate format — node identity and trust edges.
+
+Capability parity with the reference's certificate interface
+(reference: crypto/cert/cert.go:6-16 — id, name, address, uid, signers,
+serialization, active flag) without PGP packet grammar: only the *fields*
+are the capability (SURVEY.md §7 phase 3). A certificate doubles as the
+``Node`` object (reference: node/node.go:12-27 — ``Node =
+CertificateInstance``); trust edges are the embedded signatures
+(signer → signee), which the graph layer consumes directly.
+
+Wire layout (all chunks length-prefixed per ``bftkv_tpu.packet``):
+
+    magic "BCR1" | chunk(n big-endian) | u32 e | chunk(name) |
+    chunk(address) | chunk(uid) | u16 nsigs | nsigs × (u64 signer_id |
+    chunk(sig))
+
+The to-be-signed region is everything before ``nsigs``; a signature is a
+PKCS#1 v1.5/SHA-256 signature over it by the signer's key. The node id
+is the first 8 bytes (big-endian) of SHA-256 over the public key — the
+analog of the PGP 64-bit key id.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import struct
+from dataclasses import dataclass, field
+
+from bftkv_tpu.errors import ERR_INVALID_SIGNATURE, ERR_MALFORMED_REQUEST
+from bftkv_tpu.crypto import rsa
+from bftkv_tpu.packet import read_chunk, write_chunk
+
+_MAGIC = b"BCR1"
+
+
+def key_id(n: int, e: int) -> int:
+    h = hashlib.sha256()
+    h.update(n.to_bytes((n.bit_length() + 7) // 8, "big"))
+    h.update(struct.pack(">I", e))
+    return struct.unpack(">Q", h.digest()[:8])[0]
+
+
+@dataclass
+class Certificate:
+    """A parsed certificate; implements the Node capability set."""
+
+    n: int
+    e: int = rsa.F4
+    name: str = ""
+    address: str = ""
+    uid: str = ""
+    # signer_id -> signature bytes over tbs(); dict keeps one edge per signer
+    signatures: dict[int, bytes] = field(default_factory=dict)
+    active: bool = True
+
+    # -- identity ---------------------------------------------------------
+    @property
+    def id(self) -> int:
+        # Cached: id backs __hash__/__eq__ and the hot graph/quorum
+        # loops; (n, e) never changes after construction.
+        cached = self.__dict__.get("_id")
+        if cached is None:
+            cached = key_id(self.n, self.e)
+            self.__dict__["_id"] = cached
+        return cached
+
+    @property
+    def public_key(self) -> rsa.PublicKey:
+        return rsa.PublicKey(n=self.n, e=self.e)
+
+    def __hash__(self) -> int:
+        return hash(self.id)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Certificate) and other.id == self.id
+
+    # -- serialization ----------------------------------------------------
+    def tbs(self) -> bytes:
+        buf = io.BytesIO()
+        buf.write(_MAGIC)
+        nb = self.n.to_bytes((self.n.bit_length() + 7) // 8, "big")
+        write_chunk(buf, nb)
+        buf.write(struct.pack(">I", self.e))
+        write_chunk(buf, self.name.encode())
+        write_chunk(buf, self.address.encode())
+        write_chunk(buf, self.uid.encode())
+        return buf.getvalue()
+
+    def serialize(self) -> bytes:
+        buf = io.BytesIO()
+        buf.write(self.tbs())
+        buf.write(struct.pack(">H", len(self.signatures)))
+        for signer_id, sig in self.signatures.items():
+            buf.write(struct.pack(">Q", signer_id))
+            write_chunk(buf, sig)
+        return buf.getvalue()
+
+    # -- trust edges ------------------------------------------------------
+    def signers(self) -> list[int]:
+        """Ids of nodes that signed this certificate (trust edges in)."""
+        return list(self.signatures.keys())
+
+    def add_signature(self, signer_id: int, sig: bytes) -> None:
+        self.signatures[signer_id] = sig
+
+    def verify_signature(self, signer: "Certificate") -> bool:
+        """Check ``signer``'s edge onto this cert."""
+        sig = self.signatures.get(signer.id)
+        if sig is None:
+            return False
+        return rsa.verify_host(self.tbs(), sig, signer.public_key)
+
+    def merge(self, other: "Certificate") -> None:
+        """Union the signature sets (reference: crypto_pgp.go:283-305)."""
+        if other.id != self.id:
+            raise ERR_INVALID_SIGNATURE
+        for signer_id, sig in other.signatures.items():
+            self.signatures.setdefault(signer_id, sig)
+
+
+def sign_certificate(cert: Certificate, signer_key: rsa.PrivateKey) -> None:
+    """Add signer's trust edge onto ``cert``
+    (reference: crypto_pgp.go:252-281)."""
+    sig = rsa.sign(cert.tbs(), signer_key)
+    cert.add_signature(key_id(signer_key.n, signer_key.e), sig)
+
+
+def _parse_one(r: io.BytesIO) -> Certificate | None:
+    magic = r.read(4)
+    if len(magic) == 0:
+        return None
+    if magic != _MAGIC:
+        raise ERR_MALFORMED_REQUEST
+    try:
+        nb = read_chunk(r)
+        if nb is None:
+            raise ERR_MALFORMED_REQUEST
+        eb = r.read(4)
+        if len(eb) < 4:
+            raise ERR_MALFORMED_REQUEST
+        e = struct.unpack(">I", eb)[0]
+        name = (read_chunk(r) or b"").decode()
+        address = (read_chunk(r) or b"").decode()
+        uid = (read_chunk(r) or b"").decode()
+        cb = r.read(2)
+        if len(cb) < 2:
+            raise ERR_MALFORMED_REQUEST
+        nsigs = struct.unpack(">H", cb)[0]
+        sigs: dict[int, bytes] = {}
+        for _ in range(nsigs):
+            ib = r.read(8)
+            if len(ib) < 8:
+                raise ERR_MALFORMED_REQUEST
+            signer_id = struct.unpack(">Q", ib)[0]
+            sigs[signer_id] = read_chunk(r) or b""
+    except (EOFError, UnicodeDecodeError):
+        # Truncated records and non-UTF-8 field bytes are malformed
+        # certificates, never unhandled exceptions.
+        raise ERR_MALFORMED_REQUEST from None
+    return Certificate(
+        n=int.from_bytes(nb, "big"),
+        e=e,
+        name=name,
+        address=address,
+        uid=uid,
+        signatures=sigs,
+    )
+
+
+def parse(data: bytes) -> list[Certificate]:
+    """Parse a concatenation of certificates (a "ring" fragment,
+    reference: crypto_pgp.go:228-250)."""
+    r = io.BytesIO(data)
+    out: list[Certificate] = []
+    while True:
+        c = _parse_one(r)
+        if c is None:
+            return out
+        out.append(c)
+
+
+def serialize_many(certs: list[Certificate]) -> bytes:
+    return b"".join(c.serialize() for c in certs)

@@ -1,0 +1,154 @@
+"""Message security: sign-then-encrypt with nonce echo.
+
+Capability parity with the reference's transport session layer
+(reference: crypto_pgp.go:418-471): every peer-to-peer payload is signed
+by the sender, encrypted to the recipient set, and carries a nonce the
+responder must echo (replay protection — the reference smuggles the nonce
+through the PGP literal-data filename; here it is a first-class field).
+
+Hybrid scheme: fresh AES-256-GCM content key, wrapped per-recipient with
+RSA-OAEP(SHA-256). The sender's certificate rides inside the signed
+envelope so a recipient that has never seen the sender (the Join flow,
+reference: server.go:64-120) can still authenticate the message and
+decide trust at the protocol layer.
+
+Inner (signed) envelope:
+    chunk(plaintext) | chunk(nonce) | chunk(sender_cert)
+Outer:
+    u16 nrecip | nrecip × (u64 recipient_id | chunk(wrapped_key)) |
+    chunk(gcm_nonce | ciphertext(inner | chunk(sig)))
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import padding as _padding
+from cryptography.hazmat.primitives.asymmetric import rsa as _crsa
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from bftkv_tpu.crypto import cert as certmod
+from bftkv_tpu.crypto import rsa
+from bftkv_tpu.errors import (
+    ERR_DECRYPTION_FAILURE,
+    ERR_INVALID_SIGNATURE,
+    ERR_INVALID_TRANSPORT_SECURITY_DATA,
+)
+from bftkv_tpu.packet import read_chunk, write_chunk
+
+_OAEP = _padding.OAEP(
+    mgf=_padding.MGF1(algorithm=hashes.SHA256()),
+    algorithm=hashes.SHA256(),
+    label=None,
+)
+
+
+def _public(c: certmod.Certificate):
+    return _crsa.RSAPublicNumbers(c.e, c.n).public_key()
+
+
+def _private(key: rsa.PrivateKey):
+    dmp1 = key.d % (key.p - 1)
+    dmq1 = key.d % (key.q - 1)
+    iqmp = pow(key.q, -1, key.p)
+    pub = _crsa.RSAPublicNumbers(key.e, key.n)
+    return _crsa.RSAPrivateNumbers(
+        p=key.p, q=key.q, d=key.d, dmp1=dmp1, dmq1=dmq1, iqmp=iqmp,
+        public_numbers=pub,
+    ).private_key()
+
+
+class MessageSecurity:
+    """Bound to one identity (signing key + cert)."""
+
+    def __init__(self, key: rsa.PrivateKey, certificate: certmod.Certificate):
+        self.key = key
+        self.cert = certificate
+        self._priv = _private(key)
+
+    def encrypt(
+        self,
+        recipients: list[certmod.Certificate],
+        plaintext: bytes,
+        nonce: bytes,
+    ) -> bytes:
+        inner = io.BytesIO()
+        write_chunk(inner, plaintext)
+        write_chunk(inner, nonce)
+        write_chunk(inner, self.cert.serialize())
+        body = inner.getvalue()
+        sig = rsa.sign(body, self.key)
+        signed = io.BytesIO()
+        signed.write(body)
+        write_chunk(signed, sig)
+
+        content_key = os.urandom(32)
+        gcm_nonce = os.urandom(12)
+        ct = AESGCM(content_key).encrypt(gcm_nonce, signed.getvalue(), None)
+
+        out = io.BytesIO()
+        out.write(struct.pack(">H", len(recipients)))
+        for r in recipients:
+            wrapped = _public(r).encrypt(content_key, _OAEP)
+            out.write(struct.pack(">Q", r.id))
+            write_chunk(out, wrapped)
+        write_chunk(out, gcm_nonce + ct)
+        return out.getvalue()
+
+    def decrypt(self, data: bytes) -> tuple[bytes, certmod.Certificate, bytes]:
+        """Returns (plaintext, sender_cert, nonce); the caller is
+        responsible for deciding whether to trust ``sender_cert``
+        (reference: transport decrypt → Server.Handler dispatch,
+        http.go:143 → server.go:562)."""
+        r = io.BytesIO(data)
+        hdr = r.read(2)
+        if len(hdr) < 2:
+            raise ERR_INVALID_TRANSPORT_SECURITY_DATA
+        nrecip = struct.unpack(">H", hdr)[0]
+        wrapped = None
+        try:
+            for _ in range(nrecip):
+                ib = r.read(8)
+                if len(ib) < 8:
+                    raise ERR_INVALID_TRANSPORT_SECURITY_DATA
+                rid = struct.unpack(">Q", ib)[0]
+                wk = read_chunk(r)
+                if rid == self.cert.id:
+                    wrapped = wk
+            blob = read_chunk(r)
+        except Exception:
+            raise ERR_INVALID_TRANSPORT_SECURITY_DATA from None
+        if wrapped is None or blob is None or len(blob) < 12:
+            raise ERR_DECRYPTION_FAILURE
+        try:
+            content_key = self._priv.decrypt(wrapped, _OAEP)
+            signed = AESGCM(content_key).decrypt(blob[:12], blob[12:], None)
+        except Exception:
+            raise ERR_DECRYPTION_FAILURE from None
+
+        sr = io.BytesIO(signed)
+        try:
+            plaintext = read_chunk(sr) or b""
+            nonce = read_chunk(sr) or b""
+            cert_bytes = read_chunk(sr) or b""
+            body_end = sr.tell()
+            sig = read_chunk(sr) or b""
+        except Exception:
+            raise ERR_INVALID_TRANSPORT_SECURITY_DATA from None
+        try:
+            senders = certmod.parse(cert_bytes)
+        except Exception:
+            raise ERR_INVALID_TRANSPORT_SECURITY_DATA from None
+        if not senders:
+            raise ERR_INVALID_TRANSPORT_SECURITY_DATA
+        sender = senders[0]
+        try:
+            ok = rsa.verify_host(signed[:body_end], sig, sender.public_key)
+        except Exception:
+            ok = False
+        if not ok:
+            raise ERR_INVALID_SIGNATURE
+        return plaintext, sender, nonce

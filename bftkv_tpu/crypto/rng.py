@@ -1,0 +1,9 @@
+"""RNG capability (reference: crypto/crypto.go:83, crypto_pgp.go:559-577)."""
+
+from __future__ import annotations
+
+import os
+
+
+def generate_random(n: int) -> bytes:
+    return os.urandom(n)

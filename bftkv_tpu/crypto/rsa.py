@@ -108,24 +108,36 @@ class VerifierDomain:
 
     All keys in one domain share a limb width (2048-bit by default);
     heterogeneous batches mix keys freely since every element carries its
-    own modulus row.
+    own modulus row. Keys that can't go through the device kernel — a
+    non-65537 exponent, or a hostile modulus (even / zero / wider than
+    the limb budget, reachable from attacker-embedded certificates) —
+    fall back to the host oracle or fail closed; they never raise out of
+    the verification path.
     """
 
     def __init__(self, nlimbs: int = 128):
         self.nlimbs = nlimbs
-        self._cache: dict[int, bigint.MontgomeryDomain] = {}
+        self._cache: dict[int, bigint.MontgomeryDomain | None] = {}
 
-    def _dom(self, n: int) -> bigint.MontgomeryDomain:
-        dom = self._cache.get(n)
-        if dom is None:
-            dom = bigint.MontgomeryDomain(n, self.nlimbs)
+    def _dom(self, n: int) -> bigint.MontgomeryDomain | None:
+        """Montgomery domain for ``n``, or None if ``n`` is unusable."""
+        dom = self._cache.get(n, False)
+        if dom is False:
+            try:
+                dom = bigint.MontgomeryDomain(n, self.nlimbs)
+            except ValueError:
+                dom = None
             self._cache[n] = dom
         return dom
 
     def assemble(
         self, items: list[tuple[bytes, bytes, PublicKey]]
     ) -> tuple[np.ndarray, ...]:
-        """items = [(message, sig, key)] → operand arrays for the kernel."""
+        """items = [(message, sig, key)] → operand arrays for the kernel.
+
+        Every key must have e = 65537 and a kernel-compatible modulus
+        (``verify_batch`` pre-filters; direct callers own that check).
+        """
         sigs, ems, ns, nps, r2s = [], [], [], [], []
         for message, sig_bytes, key in items:
             dom = self._dom(key.n)
@@ -150,7 +162,26 @@ class VerifierDomain:
         """Batched TPU verify of [(message, sig, key)] → (batch,) bool."""
         from bftkv_tpu.ops import rsa as rsa_ops
 
-        if not items:
-            return np.zeros((0,), dtype=bool)
-        sig, em, n, npr, r2 = self.assemble(items)
-        return np.asarray(rsa_ops.verify_batch_e65537(sig, em, n, npr, r2))
+        out = np.zeros((len(items),), dtype=bool)
+        device_idx: list[int] = []
+        device_items: list[tuple[bytes, bytes, PublicKey]] = []
+        for i, (message, sig_bytes, key) in enumerate(items):
+            # 512-bit floor keeps the PKCS#1 encoding well-defined.
+            if (
+                key.e == F4
+                and key.n.bit_length() >= 512
+                and self._dom(key.n) is not None
+            ):
+                device_idx.append(i)
+                device_items.append((message, sig_bytes, key))
+            else:
+                # Host oracle for odd exponents; fails closed on junk keys.
+                try:
+                    out[i] = key.n > 0 and verify_host(message, sig_bytes, key)
+                except Exception:
+                    out[i] = False
+        if device_items:
+            sig, em, n, npr, r2 = self.assemble(device_items)
+            ok = np.asarray(rsa_ops.verify_batch_e65537(sig, em, n, npr, r2))
+            out[np.asarray(device_idx)] = ok
+        return out

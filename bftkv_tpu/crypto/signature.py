@@ -1,0 +1,232 @@
+"""Detached signatures and collective signatures, TPU-batched verify.
+
+Capability parity with the reference's ``Signature`` and
+``CollectiveSignature`` interfaces (reference: crypto/crypto.go:56-75):
+
+- an individual signature packet carries the signer id and may embed the
+  signer's certificate (reference: crypto_pgp.go:310-405);
+- a *collective* signature is a concatenation of individual detached
+  signatures; ``combine`` appends new signers and reports completion once
+  the quorum's ``is_sufficient`` predicate holds; ``verify`` counts
+  distinct valid signers (reference: crypto_pgp.go:477-519).
+
+TPU redesign: ``verify`` assembles **one batch** of (message, sig, key)
+triples across all signers and runs a single jitted modexp kernel
+(``bftkv_tpu.ops.rsa.verify_batch_e65537``) instead of the reference's
+sequential per-signer ``CheckDetachedSignature`` loop — the O(n²)
+per-write cluster cost named in SURVEY.md §2.
+"""
+
+from __future__ import annotations
+
+import io
+import struct
+
+from bftkv_tpu.crypto import cert as certmod
+from bftkv_tpu.crypto import rsa
+from bftkv_tpu.errors import (
+    ERR_CERTIFICATE_NOT_FOUND,
+    ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES,
+    ERR_INVALID_SIGNATURE,
+)
+from bftkv_tpu.packet import (
+    SIGNATURE_TYPE_NATIVE,
+    SignaturePacket,
+    read_chunk,
+    write_chunk,
+)
+
+__all__ = ["Signer", "CollectiveSignature", "parse_entries", "serialize_entries"]
+
+
+def serialize_entries(entries: list[tuple[int, bytes]]) -> bytes:
+    buf = io.BytesIO()
+    for signer_id, sig in entries:
+        buf.write(struct.pack(">Q", signer_id))
+        write_chunk(buf, sig)
+    return buf.getvalue()
+
+
+def parse_entries(data: bytes | None) -> list[tuple[int, bytes]]:
+    if not data:
+        return []
+    r = io.BytesIO(data)
+    out: list[tuple[int, bytes]] = []
+    while True:
+        hdr = r.read(8)
+        if len(hdr) == 0:
+            return out
+        if len(hdr) < 8:
+            raise ERR_INVALID_SIGNATURE
+        signer_id = struct.unpack(">Q", hdr)[0]
+        try:
+            sig = read_chunk(r) or b""
+        except Exception:
+            raise ERR_INVALID_SIGNATURE from None
+        out.append((signer_id, sig))
+
+
+class Signer:
+    """Issues detached signatures bound to one identity
+    (reference: crypto_pgp.go:346-371)."""
+
+    def __init__(self, key: rsa.PrivateKey, certificate: certmod.Certificate):
+        self.key = key
+        self.cert = certificate
+
+    def issue(self, tbs: bytes, *, include_cert: bool = True) -> SignaturePacket:
+        sig = rsa.sign(tbs, self.key)
+        return SignaturePacket(
+            type=SIGNATURE_TYPE_NATIVE,
+            version=1,
+            completed=True,
+            data=serialize_entries([(self.cert.id, sig)]),
+            cert=self.cert.serialize() if include_cert else None,
+        )
+
+
+def _resolve_cert(
+    signer_id: int,
+    keyring,
+    embedded: dict[int, certmod.Certificate],
+) -> certmod.Certificate | None:
+    c = keyring.get(signer_id) if keyring is not None else None
+    if c is None:
+        c = embedded.get(signer_id)
+    return c
+
+
+def _embedded_certs(pkt: SignaturePacket) -> dict[int, certmod.Certificate]:
+    if not pkt.cert:
+        return {}
+    return {c.id: c for c in certmod.parse(pkt.cert)}
+
+
+def signers(pkt: SignaturePacket | None) -> list[int]:
+    """Ids of everyone who signed (no verification —
+    reference: crypto_pgp.go:373-405). Malformed data yields []."""
+    if pkt is None or not pkt.data:
+        return []
+    try:
+        return [sid for sid, _ in parse_entries(pkt.data)]
+    except Exception:
+        return []
+
+
+class CollectiveSignature:
+    """Concatenated detached signatures with batched verification
+    (reference: crypto_pgp.go:477-519)."""
+
+    def __init__(self, verifier: rsa.VerifierDomain | None = None):
+        self.verifier = verifier or rsa.VerifierDomain()
+
+    def verify(self, tbss: bytes, ss: SignaturePacket | None, quorum, keyring) -> None:
+        """Raise unless enough *distinct, quorum-member* signers verify.
+
+        One TPU batch over every entry — all signatures verify in a
+        single kernel launch.
+        """
+        try:
+            entries = parse_entries(ss.data if ss else None)
+            embedded = _embedded_certs(ss) if ss else {}
+        except Exception:
+            # Hostile packet bytes (torn entries, junk certs) are an
+            # invalid signature, never an unhandled exception.
+            raise ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES from None
+        items: list[tuple[bytes, bytes, rsa.PublicKey]] = []
+        certs: list[certmod.Certificate] = []
+        for signer_id, sig in entries:
+            c = _resolve_cert(signer_id, keyring, embedded)
+            if c is None:
+                continue
+            items.append((tbss, sig, c.public_key))
+            certs.append(c)
+        if not items:
+            raise ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES
+        ok = self.verifier.verify_batch(items)
+        valid = {c for c, good in zip(certs, ok) if good}
+        if not quorum.is_sufficient(list(valid)):
+            raise ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES
+
+    def sign(
+        self, signer: Signer, tbss: bytes, *, completed: bool = False
+    ) -> SignaturePacket:
+        """This node's share of a collective signature
+        (reference: crypto_pgp.go:477-484)."""
+        pkt = signer.issue(tbss)
+        pkt.completed = completed
+        return pkt
+
+    def combine(
+        self,
+        ss: SignaturePacket | None,
+        share: SignaturePacket,
+        quorum,
+        keyring=None,
+    ) -> tuple[SignaturePacket, bool]:
+        """Append ``share``'s entries into ``ss``; returns the updated
+        packet and whether the signer set is now sufficient
+        (reference: crypto_pgp.go:486-503)."""
+        if ss is None or not ss.data:
+            ss = SignaturePacket(
+                type=SIGNATURE_TYPE_NATIVE, version=1, completed=False, data=b""
+            )
+        entries = dict(parse_entries(ss.data))
+        # Refuse to merge mismatched packet types (reference:
+        # crypto_pgp.go:506-511) or unparsable share bytes — the share is
+        # simply not counted.
+        try:
+            if share.type == ss.type:
+                for sid, sig in parse_entries(share.data):
+                    entries.setdefault(sid, sig)
+        except Exception:
+            pass
+        ss.data = serialize_entries(list(entries.items()))
+        # Merge embedded certs so later verification can resolve signers
+        # that are not yet in the verifier's keyring.
+        merged = _embedded_certs(ss)
+        if share.cert:
+            try:
+                for c in certmod.parse(share.cert):
+                    merged.setdefault(c.id, c)
+            except Exception:
+                pass
+        ss.cert = certmod.serialize_many(list(merged.values())) or None
+        nodes = []
+        for sid in entries:
+            c = _resolve_cert(sid, keyring, merged)
+            if c is not None:
+                nodes.append(c)
+        done = quorum.is_sufficient(nodes)
+        ss.completed = done
+        return ss, done
+
+
+def verify_with_certificate(
+    tbs: bytes, pkt: SignaturePacket | None, certificate: certmod.Certificate
+) -> None:
+    """Verify a single-signer packet against a known certificate
+    (reference: crypto/crypto.go:60, used by server.go:207)."""
+    if pkt is None or not pkt.data:
+        raise ERR_INVALID_SIGNATURE
+    for sid, sig in parse_entries(pkt.data):
+        if sid == certificate.id:
+            if rsa.verify_host(tbs, sig, certificate.public_key):
+                return
+            raise ERR_INVALID_SIGNATURE
+    raise ERR_INVALID_SIGNATURE
+
+
+def issuer(pkt: SignaturePacket | None, keyring) -> certmod.Certificate:
+    """The (first) signer's certificate, from keyring or embedded."""
+    if pkt is None or not pkt.data:
+        raise ERR_CERTIFICATE_NOT_FOUND
+    try:
+        embedded = _embedded_certs(pkt)
+    except Exception:
+        embedded = {}
+    for sid, _ in parse_entries(pkt.data):
+        c = _resolve_cert(sid, keyring, embedded)
+        if c is not None:
+            return c
+    raise ERR_CERTIFICATE_NOT_FOUND

@@ -1,0 +1,299 @@
+"""Web-of-Trust graph: the membership/trust substrate.
+
+Capability parity with the reference trust graph
+(reference: node/graph/graph.go:20-438). Vertices are 64-bit node ids;
+a directed edge signer → signee exists for every certificate signature.
+Quorums are maximal cliques in this graph (reference: docs/design.md:61-69).
+
+Semantics preserved exactly (SURVEY.md §7 hard part #5):
+
+- ``add_nodes`` skips revoked ids, creates placeholder vertices (no
+  instance) for unknown signers, and replaces the instance on re-add
+  (graph.go:46-75);
+- ``find_maximal_clique`` *assumes a unique maximal clique per seed*:
+  it grows one clique greedily, then if any other vertex is mutually
+  connected to the seed but outside the clique it logs and returns
+  ``None`` (graph.go:332-362);
+- clique weight = number of seed out-edges into the clique
+  (graph.go:385-393);
+- ``get_in_reachable`` excludes destinations themselves and short-
+  circuits on the first destination match (graph.go:395-418);
+- the graph itself implements the node interface by delegating to
+  ``self_vertices[0]`` (graph.go:224-257).
+
+The graph also exports a dense boolean adjacency view
+(``adjacency``) so quorum tallies and clique checks can run as vmapped
+boolean reductions on device (``bftkv_tpu.ops.tally``) — the
+"vote tallying" target of BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+log = logging.getLogger("bftkv_tpu.graph")
+
+
+@dataclass
+class Vertex:
+    instance: object | None = None
+    # out-edges: signee id -> Vertex (this vertex signed those certs)
+    edges: dict[int, "Vertex"] = field(default_factory=dict)
+
+
+@dataclass
+class Clique:
+    nodes: list = field(default_factory=list)
+    weight: int = 0
+
+
+class Graph:
+    def __init__(self):
+        self.vertices: dict[int, Vertex] = {}
+        self.revoked: dict[int, object | None] = {}
+        self.self_vertices: list[Vertex] = []
+
+    # -- construction (graph.go:46-146) -----------------------------------
+    def add_nodes(self, nodes: list) -> list:
+        res = []
+        for n in nodes:
+            skid = n.id
+            if skid in self.revoked:
+                continue
+            self_v = self.vertices.get(skid)
+            if self_v is None:
+                self_v = Vertex(instance=n)
+                self.vertices[skid] = self_v
+            else:
+                self_v.instance = n  # replace with the newly added one
+            for signer in n.signers():
+                if signer in self.revoked:
+                    continue
+                v = self.vertices.get(signer)
+                if v is None:
+                    v = Vertex(instance=None)  # placeholder
+                    self.vertices[signer] = v
+                v.edges[skid] = self_v
+            res.append(n)
+        return res
+
+    def set_self_nodes(self, nodes: list) -> None:
+        for n in nodes:
+            v = self.vertices.get(n.id)
+            if v is None or v.instance is None:
+                self.add_nodes([n])
+                v = self.vertices[n.id]
+            self.self_vertices.append(v)
+
+    def remove_nodes(self, nodes: list) -> None:
+        for n in nodes:
+            nid = n.id
+            for v in self.vertices.values():
+                v.edges.pop(nid, None)
+            self.vertices.pop(nid, None)
+            for i, sv in enumerate(self.self_vertices):
+                if sv.instance is not None and sv.instance.id == nid:
+                    del self.self_vertices[i]
+                    break
+
+    def add_peers(self, peers: list) -> list:
+        peers = self.add_nodes(peers)
+        for n in peers:
+            n.active = True
+        return peers
+
+    def get_peers(self) -> list:
+        self_id = self.get_self_id()
+        return [
+            v.instance
+            for v in self.vertices.values()
+            if v.instance is not None and v.instance.id != self_id
+        ]
+
+    def remove_peers(self, peers: list) -> None:
+        self.remove_nodes(peers)
+
+    def revoke(self, n) -> None:
+        v = self.vertices.get(n.id)
+        instance = None
+        if v is not None:
+            instance = v.instance
+            self.remove_nodes([instance] if instance is not None else [n])
+        self.revoked[n.id] = instance
+
+    def revoke_nodes(self, nodes: list) -> None:
+        for n in nodes:
+            self.revoked[n.id] = n
+
+    def in_graph(self, n) -> bool:
+        return n.id in self.vertices
+
+    # -- serialization (graph.go:148-213) ---------------------------------
+    def serialize_self(self) -> bytes:
+        return b"".join(
+            v.instance.serialize()
+            for v in self.self_vertices
+            if v.instance is not None
+        )
+
+    def serialize_nodes(self) -> bytes:
+        out = [self.serialize_self()]
+        for v in self.vertices.values():
+            if v.instance is None or v in self.self_vertices:
+                continue
+            out.append(v.instance.serialize())
+        return b"".join(out)
+
+    def serialize_revoked(self) -> bytes:
+        return b"".join(
+            n.serialize() for n in self.revoked.values() if n is not None
+        )
+
+    # -- node interface by delegation (graph.go:224-257) ------------------
+    @property
+    def id(self) -> int:
+        return self.self_vertices[0].instance.id
+
+    @property
+    def name(self) -> str:
+        return self.self_vertices[0].instance.name
+
+    @property
+    def address(self) -> str:
+        return self.self_vertices[0].instance.address
+
+    @property
+    def uid(self) -> str:
+        return self.self_vertices[0].instance.uid
+
+    def signers(self) -> list[int]:
+        return self.self_vertices[0].instance.signers()
+
+    def serialize(self) -> bytes:
+        return self.self_vertices[0].instance.serialize()
+
+    def get_self_id(self) -> int:
+        if not self.self_vertices or self.self_vertices[0].instance is None:
+            return 0
+        return self.self_vertices[0].instance.id
+
+    def size(self) -> int:
+        return len(self.vertices)
+
+    # -- traversal (graph.go:279-438) -------------------------------------
+    def _bfs(self, start: Vertex):
+        """Yield (vertex, distance) in BFS order over out-edges."""
+        seen = {start.instance.id}
+        q = deque([(start, 0)])
+        while q:
+            v, d = q.popleft()
+            yield v, d
+            for vid, e in v.edges.items():
+                if vid not in seen:
+                    seen.add(vid)
+                    q.append((e, d + 1))
+
+    def get_reachable_nodes(self, sid: int, distance: int) -> list:
+        v = self.vertices.get(sid)
+        if v is None:
+            return []
+        nodes = []
+        for vd, d in self._bfs(v):
+            if distance >= 0 and d > distance:
+                break
+            if vd.instance is not None:
+                nodes.append(vd.instance)
+        return nodes
+
+    def get_cliques(self, sid: int, distance: int) -> list[Clique]:
+        start = self.vertices.get(sid)
+        cliques: list[Clique] = []
+        if start is None or start.instance is None:
+            return cliques
+        found_ids: set[int] = set()
+        for vd, d in self._bfs(start):
+            if distance >= 0 and d > distance:
+                break
+            if vd.instance is None or vd.instance.id in found_ids:
+                continue
+            clique = self._find_maximal_clique(vd)
+            if clique is not None:
+                clique.weight = sum(
+                    1 for n in clique.nodes if n.id in start.edges
+                )
+                cliques.append(clique)
+                found_ids.update(n.id for n in clique.nodes)
+        return cliques
+
+    def _bidirect(self, v: Vertex, clique: list[Vertex]) -> bool:
+        vid = v.instance.id
+        for c in clique:
+            if vid not in c.edges or c.instance.id not in v.edges:
+                return False
+        return True
+
+    def _find_maximal_clique(self, s: Vertex) -> Clique | None:
+        """Grow one clique greedily; bail if it is not unique
+        (graph.go:332-362)."""
+        clique = [s]
+        for v in self.vertices.values():
+            if v.instance is None or v is s:
+                continue
+            if self._bidirect(v, clique):
+                clique.append(v)
+        members = set(id(c) for c in clique)
+        for v in self.vertices.values():
+            if (
+                v.instance is not None
+                and v is not s
+                and id(v) not in members
+                and self._bidirect(v, [s])
+            ):
+                log.info(
+                    "graph: found more than one maximal clique for %s <-> %s",
+                    s.instance.name,
+                    v.instance.name,
+                )
+                return None
+        return Clique(nodes=[c.instance for c in clique])
+
+    def get_in_reachable(self, destinations: list) -> list:
+        res = []
+        self_id = self.get_self_id()
+        for v in self.vertices.values():
+            if v.instance is None or v.instance.id == self_id:
+                continue
+            tid = v.instance.id
+            found = False
+            for d in destinations:
+                if d.id == tid:  # exclude destinations themselves
+                    found = False
+                    break
+                if d.id in v.edges:
+                    found = True
+            if found:
+                res.append(v.instance)
+        return res
+
+    # -- dense views for device tallies -----------------------------------
+    def adjacency(self) -> tuple[np.ndarray, list[int]]:
+        """Boolean adjacency matrix over nodes with instances, plus the
+        id order. ``adj[i, j]`` = node i signed node j's cert."""
+        ids = [
+            vid for vid, v in self.vertices.items() if v.instance is not None
+        ]
+        index = {vid: i for i, vid in enumerate(ids)}
+        adj = np.zeros((len(ids), len(ids)), dtype=bool)
+        for vid, v in self.vertices.items():
+            i = index.get(vid)
+            if i is None:
+                continue
+            for tid in v.edges:
+                j = index.get(tid)
+                if j is not None:
+                    adj[i, j] = True
+        return adj, ids

@@ -1,0 +1,96 @@
+"""Batched quorum tallies as vmapped boolean reductions.
+
+The reference evaluates quorum predicates one candidate set at a time
+with O(|s1|·|s2|) nested loops inside every multicast callback
+(reference: quorum/wotqs/wotqs.go:144-206 ``intersection``). Here a
+whole *batch* of candidate sets — e.g. the signer sets of thousands of
+concurrent reads during a revoke-on-read sweep, or per-request ack sets
+in the benchmark harness — tallies against every quorum clique in one
+einsum on device (BASELINE.json: "vote tallying ... vmapped reduction
+over replica batches").
+
+Inputs are dense boolean arrays over a node universe of size U:
+``membership`` is ``(nqc, U)`` (one row per quorum clique) and
+``candidates`` is ``(batch, U)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "counts",
+    "is_quorum_batch",
+    "is_sufficient_batch",
+    "is_threshold_batch",
+    "reject_batch",
+    "equivocation_pairs",
+]
+
+
+@jax.jit
+def counts(membership: jnp.ndarray, candidates: jnp.ndarray) -> jnp.ndarray:
+    """Intersection sizes, ``(batch, nqc)`` int32."""
+    return jnp.einsum(
+        "qu,bu->bq",
+        membership.astype(jnp.int32),
+        candidates.astype(jnp.int32),
+    )
+
+
+@jax.jit
+def is_threshold_batch(
+    membership: jnp.ndarray, candidates: jnp.ndarray, threshold: jnp.ndarray
+) -> jnp.ndarray:
+    """(batch,) bool — wotqs.go:157-167 vectorized over candidate sets."""
+    c = counts(membership, candidates)
+    ok = (threshold[None, :] <= 0) | (c >= threshold[None, :])
+    any_qc = membership.shape[0] > 0
+    return jnp.all(ok, axis=-1) & any_qc
+
+
+@jax.jit
+def is_quorum_batch(
+    membership: jnp.ndarray,
+    candidates: jnp.ndarray,
+    f: jnp.ndarray,
+    min_: jnp.ndarray,
+) -> jnp.ndarray:
+    """(batch,) bool — wotqs.go:144-155."""
+    c = counts(membership, candidates)
+    ok = (f[None, :] <= 0) | (c >= min_[None, :])
+    return jnp.all(ok, axis=-1) & (membership.shape[0] > 0)
+
+
+@jax.jit
+def is_sufficient_batch(
+    membership: jnp.ndarray, candidates: jnp.ndarray, suff: jnp.ndarray
+) -> jnp.ndarray:
+    """(batch,) bool — wotqs.go:169-176."""
+    c = counts(membership, candidates)
+    return jnp.any((suff[None, :] > 0) & (c >= suff[None, :]), axis=-1)
+
+
+@jax.jit
+def reject_batch(
+    membership: jnp.ndarray, candidates: jnp.ndarray, f: jnp.ndarray
+) -> jnp.ndarray:
+    """(batch,) bool — wotqs.go:178-185 (vacuously true with no qcs)."""
+    c = counts(membership, candidates)
+    ok = (f[None, :] > 0) & (c > f[None, :])
+    return jnp.all(ok, axis=-1)
+
+
+@jax.jit
+def equivocation_pairs(signer_sets: jnp.ndarray) -> jnp.ndarray:
+    """Signers that signed two different values at the same timestamp.
+
+    ``signer_sets`` is ``(nvalues, U)`` bool — one row per distinct value
+    observed at one timestamp, marking which nodes signed it. Returns a
+    ``(U,)`` bool mask of equivocators: nodes present in more than one
+    row (the batched form of the reference's revoke-on-read scan,
+    protocol/client.go:304-341).
+    """
+    per_node = signer_sets.astype(jnp.int32).sum(axis=0)
+    return per_node >= 2

@@ -1,0 +1,40 @@
+"""Quorum interfaces (reference: quorum/quorum.go:10-29).
+
+Access-type flags combine to pick quorum shape and trust distance:
+``READ | AUTH`` for the timestamp phase, ``AUTH | PEER`` for signature
+collection, ``WRITE`` for the store phase, ``AUTH | CERT`` for quorum-
+certificate checks (reference call sites: protocol/client.go:64,101,141,
+protocol/server.go:211).
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+READ = 0x01
+WRITE = 0x02
+AUTH = 0x04
+CERT = 0x08
+PEER = 0x10
+
+__all__ = ["READ", "WRITE", "AUTH", "CERT", "PEER", "Quorum", "QuorumSystem"]
+
+
+@runtime_checkable
+class Quorum(Protocol):
+    def nodes(self) -> list: ...
+
+    def is_quorum(self, nodes: list) -> bool: ...
+
+    def is_threshold(self, nodes: list) -> bool: ...
+
+    def is_sufficient(self, nodes: list) -> bool: ...
+
+    def reject(self, nodes: list) -> bool: ...
+
+    def get_threshold(self) -> int: ...
+
+
+@runtime_checkable
+class QuorumSystem(Protocol):
+    def choose_quorum(self, rw: int) -> Quorum: ...

@@ -1,0 +1,202 @@
+"""Web-of-Trust quorum system: quorums from trust-graph cliques.
+
+Capability parity with the reference wotqs
+(reference: quorum/wotqs/wotqs.go:32-206), semantics preserved exactly:
+
+- trust distance by access type — CERT: 0, AUTH: 1, else 2
+  (wotqs.go:117-127);
+- each clique becomes a quorum-clique ``qc`` with the b-masking
+  parameters f = (n-1)/3, min = 3f+1, threshold = 2f+1 (f+1 for
+  READ/CERT), suff = f + (n-f)/2 + 1, suff zeroed when the seed's
+  weight into the clique is too small (wotqs.go:36-70);
+- READ adds the complement of the reachable set, WRITE adds the
+  complement of all peers with f = 0 — "W = U − {Ci} + R"
+  (wotqs.go:72-115);
+- PEER excludes the self node (wotqs.go:38-47);
+- the predicates intersect the candidate node set against every qc
+  (wotqs.go:144-193).
+
+TPU redesign: a quorum precomputes a boolean membership matrix
+``(nqc, nuniverse)`` over a node-id index; the per-callback
+``intersection`` loops (the O(|s1|·|s2|) hot path flagged in SURVEY.md
+§2) become vectorized membership counts, and the same matrix feeds the
+batched device tallies in ``bftkv_tpu.ops.tally`` for bulk paths
+(revoke-on-read over many reads at once).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from bftkv_tpu import quorum as q
+
+
+def _howmany(a: int, b: int) -> int:
+    return (a + b - 1) // b
+
+
+@dataclass
+class QC:
+    """One quorum clique with its b-masking parameters (wotqs.go:16-22)."""
+
+    nodes: list
+    f: int = 0
+    min: int = 0
+    threshold: int = 0
+    suff: int = 0
+
+
+@dataclass
+class WotQuorum:
+    qcs: list[QC] = field(default_factory=list)
+
+    def __post_init__(self):
+        # id universe + per-qc membership rows for vectorized tallies
+        ids: list[int] = []
+        index: dict[int, int] = {}
+        for qc in self.qcs:
+            for n in qc.nodes:
+                if n.id not in index:
+                    index[n.id] = len(ids)
+                    ids.append(n.id)
+        self._index = index
+        m = np.zeros((len(self.qcs), len(ids)), dtype=bool)
+        for i, qc in enumerate(self.qcs):
+            for n in qc.nodes:
+                m[i, index[n.id]] = True
+        self._membership = m
+        self._f = np.array([qc.f for qc in self.qcs], dtype=np.int32)
+        self._min = np.array([qc.min for qc in self.qcs], dtype=np.int32)
+        self._threshold = np.array(
+            [qc.threshold for qc in self.qcs], dtype=np.int32
+        )
+        self._suff = np.array([qc.suff for qc in self.qcs], dtype=np.int32)
+
+    # -- vectorized intersection counts -----------------------------------
+    def mask_of(self, nodes: list) -> np.ndarray:
+        mask = np.zeros(len(self._index), dtype=bool)
+        for n in nodes:
+            i = self._index.get(n.id)
+            if i is not None:
+                mask[i] = True
+        return mask
+
+    def _counts(self, nodes: list) -> np.ndarray:
+        if not self.qcs:
+            return np.zeros(0, dtype=np.int64)
+        return self._membership.astype(np.int32) @ self.mask_of(nodes).astype(
+            np.int32
+        )
+
+    # -- Quorum interface (wotqs.go:132-193) ------------------------------
+    def nodes(self) -> list:
+        out = []
+        for qc in self.qcs:
+            for n in qc.nodes:
+                if n.active and n.address != "":
+                    out.append(n)
+        return out
+
+    def is_quorum(self, nodes: list) -> bool:
+        if not self.qcs:
+            return False
+        c = self._counts(nodes)
+        return bool(np.all((self._f <= 0) | (c >= self._min)))
+
+    def is_threshold(self, nodes: list) -> bool:
+        if not self.qcs:
+            return False
+        c = self._counts(nodes)
+        return bool(np.all((self._threshold <= 0) | (c >= self._threshold)))
+
+    def is_sufficient(self, nodes: list) -> bool:
+        c = self._counts(nodes)
+        return bool(np.any((self._suff > 0) & (c >= self._suff)))
+
+    def reject(self, nodes: list) -> bool:
+        # Vacuously true with no qcs (the reference's bare loop,
+        # wotqs.go:178-185) — fail-safe in degenerate trust configs.
+        c = self._counts(nodes)
+        return bool(np.all((self._f > 0) & (c > self._f)))
+
+    def get_threshold(self) -> int:
+        return int(self._threshold.sum())
+
+    # -- dense views for device tallies (bftkv_tpu.ops.tally) -------------
+    def membership_matrix(self) -> tuple[np.ndarray, dict[int, int]]:
+        return self._membership, dict(self._index)
+
+    def bounds(self) -> dict[str, np.ndarray]:
+        return {
+            "f": self._f,
+            "min": self._min,
+            "threshold": self._threshold,
+            "suff": self._suff,
+        }
+
+
+class WotQS:
+    """The quorum system over a trust graph (wotqs.go:32-34)."""
+
+    def __init__(self, graph):
+        self.g = graph
+
+    def _new_qc(self, nodes: list, weight: int, rw: int) -> QC | None:
+        if rw & q.PEER:
+            self_id = self.g.get_self_id()
+            nodes = [n for n in nodes if n.id != self_id]
+        n = len(nodes)
+        if n == 0:
+            return None
+        if rw == q.WRITE:
+            return QC(nodes, 0, 0, 0, 0)
+        f = (n - 1) // 3
+        if f < 1:
+            return None
+        min_ = 3 * f + 1
+        threshold = 2 * f + 1
+        suff = f + (n - f) // 2 + 1
+        if rw & (q.CERT | q.READ):
+            threshold = f + 1
+        if weight <= n - suff:
+            suff = 0
+        return QC(nodes, f, min_, threshold, suff)
+
+    def _complement(
+        self, u: list, c: list[QC], e: list[QC], rw: int
+    ) -> list[QC]:
+        covered = {n.id for qc in c for n in qc.nodes}
+        nodes = [n for n in u if n.id not in covered]
+        qc = self._new_qc(nodes, 0, rw)
+        if qc is not None:
+            e = e + [qc]
+        return e
+
+    def _quorum_from(self, rw: int, sid: int, distance: int) -> WotQuorum:
+        qcs: list[QC] = []
+        for c in self.g.get_cliques(sid, distance):
+            qc = self._new_qc(c.nodes, c.weight, rw | q.AUTH)
+            if qc is not None:
+                qcs.append(qc)
+        if rw & (q.READ | q.WRITE):
+            e = qcs if rw & q.AUTH else []
+            e = self._complement(
+                self.g.get_reachable_nodes(sid, distance), qcs, e, q.READ
+            )  # R = {Vi} - {Ci}
+            if rw & q.WRITE:
+                e = self._complement(
+                    self.g.get_peers(), qcs + e, e, q.WRITE
+                )  # W = U - {Ci} + R
+            qcs = e
+        return WotQuorum(qcs)
+
+    def choose_quorum(self, rw: int) -> WotQuorum:
+        if rw & q.CERT:
+            distance = 0
+        elif rw & q.AUTH:
+            distance = 1
+        else:
+            distance = 2
+        return self._quorum_from(rw, self.g.get_self_id(), distance)

@@ -1,0 +1,290 @@
+"""Trust graph + WoT quorum system semantics.
+
+Topology mirrors the reference's canonical test universe
+(reference: scripts/setup.sh:17-48): servers a01–a10 and b01–b10 as two
+10-cliques, storage-only nodes rw01–rw06, users u01–u04 who sign the
+a-servers and rw nodes, with a07–a10 counter-signing the users' certs
+(u04 deliberately unsigned for TOFU tests).
+"""
+
+from dataclasses import dataclass, field
+
+import numpy as np
+import pytest
+
+from bftkv_tpu import quorum as q
+from bftkv_tpu.graph import Graph
+from bftkv_tpu.ops import tally
+from bftkv_tpu.quorum.wotqs import WotQS
+
+
+@dataclass
+class FakeNode:
+    """Duck-typed node: the graph/quorum layers only need the
+    certificate fields (reference: crypto/cert/cert.go:6-16)."""
+
+    _id: int
+    name: str
+    address: str = ""
+    uid: str = ""
+    active: bool = True
+    signer_ids: set = field(default_factory=set)
+
+    @property
+    def id(self):
+        return self._id
+
+    def signers(self):
+        return list(self.signer_ids)
+
+    def serialize(self):
+        return self.name.encode()
+
+
+def mkuniverse():
+    nodes = {}
+    nid = iter(range(1, 1000))
+
+    def add(name, address="", uid=""):
+        n = FakeNode(next(nid), name, address=address, uid=uid)
+        nodes[name] = n
+        return n
+
+    for i in range(1, 11):
+        add(f"a{i:02d}", address=f"http://a{i:02d}")
+    for i in range(1, 11):
+        add(f"b{i:02d}", address=f"http://b{i:02d}")
+    for i in range(1, 7):
+        add(f"rw{i:02d}", address=f"http://rw{i:02d}")
+    for i in (1, 2, 3, 4):
+        add(f"u{i:02d}", uid="foo@example.test")
+
+    def sign(signer, signee):
+        nodes[signee].signer_ids.add(nodes[signer].id)
+
+    # two 10-cliques: pairwise cross-signed
+    for grp in ("a", "b"):
+        names = [f"{grp}{i:02d}" for i in range(1, 11)]
+        for s1 in names:
+            for s2 in names:
+                if s1 != s2:
+                    sign(s1, s2)
+    # users sign the a-servers and rw nodes
+    for u in ("u01", "u02", "u03", "u04"):
+        for i in range(1, 11):
+            sign(u, f"a{i:02d}")
+        for i in range(1, 7):
+            sign(u, f"rw{i:02d}")
+    # a07-a10 sign the users' certs (u04 deliberately unsigned)
+    for u in ("u01", "u02", "u03"):
+        for i in (7, 8, 9, 10):
+            sign(f"a{i:02d}", u)
+    return nodes
+
+
+@pytest.fixture()
+def universe():
+    return mkuniverse()
+
+
+def build_graph(nodes, self_name):
+    g = Graph()
+    g.add_nodes(list(nodes.values()))
+    g.set_self_nodes([nodes[self_name]])
+    return g
+
+
+def names_of(nodeset, nodes):
+    byid = {n.id: name for name, n in nodes.items()}
+    return sorted(byid[n.id] for n in nodeset)
+
+
+def test_bfs_reachable(universe):
+    g = build_graph(universe, "u01")
+    # distance 0: just self
+    r0 = g.get_reachable_nodes(universe["u01"].id, 0)
+    assert names_of(r0, universe) == ["u01"]
+    # distance 1: everything u01 signed
+    r1 = g.get_reachable_nodes(universe["u01"].id, 1)
+    expected = ["u01"] + [f"a{i:02d}" for i in range(1, 11)] + [
+        f"rw{i:02d}" for i in range(1, 7)
+    ]
+    assert names_of(r1, universe) == sorted(expected)
+    # distance 2: + users signed by a07-a10 (u02, u03), b-clique unreachable
+    r2 = g.get_reachable_nodes(universe["u01"].id, 2)
+    assert "u02" in names_of(r2, universe)
+    assert "b01" not in names_of(r2, universe)
+    # BFS visits each node once
+    ids = [n.id for n in r2]
+    assert len(ids) == len(set(ids))
+
+
+def test_user_seed_clique(universe):
+    g = build_graph(universe, "u01")
+    cliques = g.get_cliques(universe["u01"].id, 0)
+    assert len(cliques) == 1
+    # u01 <-> a07..a10 are mutually signed: that's the seed clique
+    assert names_of(cliques[0].nodes, universe) == [
+        "a07",
+        "a08",
+        "a09",
+        "a10",
+        "u01",
+    ]
+
+
+def test_server_clique_and_weight(universe):
+    g = build_graph(universe, "u01")
+    cliques = g.get_cliques(universe["u01"].id, 2)
+    byset = {tuple(names_of(c.nodes, universe)): c for c in cliques}
+    a_clique = byset.get(tuple(f"a{i:02d}" for i in range(1, 11)))
+    assert a_clique is not None
+    # weight = #edges from the seed (u01) into the clique: u01 signed all 10
+    assert a_clique.weight == 10
+
+
+def test_nonunique_maximal_clique_bails(universe):
+    # x is mutually signed with members of two disjoint cliques -> the
+    # unique-maximal-clique assumption breaks and the seed yields nothing
+    # (reference: graph.go:332-362)
+    nodes = universe
+    x = FakeNode(999, "x", address="http://x")
+    nodes["x"] = x
+    for peer in ("a01", "b01"):
+        x.signer_ids.add(nodes[peer].id)
+        nodes[peer].signer_ids.add(x.id)
+    g = build_graph(nodes, "x")
+    cliques = g.get_cliques(x.id, 0)
+    assert cliques == []
+
+
+def test_revoke_removes_and_blocks_readd(universe):
+    g = build_graph(universe, "u01")
+    a01 = universe["a01"]
+    g.revoke(a01)
+    assert not g.in_graph(a01)
+    assert a01.id in g.revoked
+    # re-adding is blocked
+    g.add_nodes([a01])
+    assert not g.in_graph(a01)
+    # the a-clique shrinks to 9
+    cliques = g.get_cliques(universe["u01"].id, 2)
+    sizes = sorted(len(c.nodes) for c in cliques)
+    assert 9 in sizes
+
+
+def test_in_reachable(universe):
+    g = build_graph(universe, "a01")
+    # who signed u01 (besides destinations themselves)?
+    res = g.get_in_reachable([universe["u01"]])
+    got = names_of(res, universe)
+    assert got == ["a07", "a08", "a09", "a10"]
+
+
+def test_wotqs_cert_quorum_params(universe):
+    g = build_graph(universe, "a01")
+    qs = WotQS(g)
+    qr = qs.choose_quorum(q.CERT | q.AUTH)
+    # distance 0 from a01: the 10-clique; CERT -> threshold = f+1
+    assert len(qr.qcs) == 1
+    qc = qr.qcs[0]
+    assert (qc.f, qc.min, qc.threshold, qc.suff) == (3, 10, 4, 7)
+    a_nodes = [universe[f"a{i:02d}"] for i in range(1, 11)]
+    assert qr.is_quorum(a_nodes)
+    assert qr.is_threshold(a_nodes[:4])
+    assert not qr.is_threshold(a_nodes[:3])
+    assert qr.is_sufficient(a_nodes[:7])
+    assert not qr.is_sufficient(a_nodes[:6])
+    assert not qr.reject(a_nodes[:3])
+    assert qr.reject(a_nodes[:4])
+
+
+def test_wotqs_auth_quorum_threshold(universe):
+    g = build_graph(universe, "a01")
+    qs = WotQS(g)
+    qa = qs.choose_quorum(q.AUTH)
+    qc = qa.qcs[0]
+    assert qc.threshold == 7  # 2f+1 for AUTH
+    assert qa.get_threshold() == sum(c.threshold for c in qa.qcs)
+
+
+def test_wotqs_peer_excludes_self(universe):
+    g = build_graph(universe, "a01")
+    qs = WotQS(g)
+    qp = qs.choose_quorum(q.AUTH | q.PEER)
+    all_nodes = {n.id for qc in qp.qcs for n in qc.nodes}
+    assert universe["a01"].id not in all_nodes
+    # 9-node clique: f = 2
+    assert qp.qcs[0].f == 2
+
+
+def test_wotqs_write_quorum_covers_peers(universe):
+    g = build_graph(universe, "a01")
+    qs = WotQS(g)
+    qw = qs.choose_quorum(q.WRITE)
+    # Pure WRITE drops the clique qcs and keeps only the complements:
+    # "W = U - {Ci} + R" (wotqs.go:103-113). From a01 that is every peer
+    # outside the a-clique, with f == 0 (any node may store).
+    covered = {n.id for qc in qw.qcs for n in qc.nodes}
+    for name, n in universe.items():
+        if name.startswith(("b", "rw")):
+            assert n.id in covered, name
+        if name.startswith("a"):
+            assert n.id not in covered, name
+    assert all(qc.f == 0 for qc in qw.qcs)
+    # time phase uses READ|AUTH which *keeps* the cliques (client.go:64)
+    qt = qs.choose_quorum(q.READ | q.AUTH)
+    t_covered = {n.id for qc in qt.qcs for n in qc.nodes}
+    assert universe["a02"].id in t_covered
+
+
+def test_wotqs_inactive_nodes_filtered(universe):
+    g = build_graph(universe, "a01")
+    qs = WotQS(g)
+    universe["a02"].active = False
+    qr = qs.choose_quorum(q.CERT | q.AUTH)
+    assert universe["a02"].id not in {n.id for n in qr.nodes()}
+    universe["a02"].active = True
+
+
+def test_tally_matches_host_predicates(universe):
+    g = build_graph(universe, "a01")
+    qs = WotQS(g)
+    qr = qs.choose_quorum(q.AUTH)
+    membership, index = qr.membership_matrix()
+    bounds = qr.bounds()
+    rng = np.random.default_rng(0)
+    universe_nodes = {n.id: n for n in universe.values()}
+    ids = list(index.keys())
+    batch = []
+    masks = []
+    for _ in range(64):
+        k = rng.integers(0, len(ids) + 1)
+        chosen = rng.choice(ids, size=k, replace=False) if k else []
+        nodes = [universe_nodes[i] for i in chosen]
+        batch.append(nodes)
+        masks.append(qr.mask_of(nodes))
+    cand = np.stack(masks) if masks else np.zeros((0, len(ids)), bool)
+    th = np.asarray(
+        tally.is_threshold_batch(membership, cand, bounds["threshold"])
+    )
+    su = np.asarray(tally.is_sufficient_batch(membership, cand, bounds["suff"]))
+    rj = np.asarray(tally.reject_batch(membership, cand, bounds["f"]))
+    iq = np.asarray(
+        tally.is_quorum_batch(membership, cand, bounds["f"], bounds["min"])
+    )
+    for i, nodes in enumerate(batch):
+        assert th[i] == qr.is_threshold(nodes)
+        assert su[i] == qr.is_sufficient(nodes)
+        assert rj[i] == qr.reject(nodes)
+        assert iq[i] == qr.is_quorum(nodes)
+
+
+def test_equivocation_pairs():
+    # 3 values at one timestamp; node 2 signed two of them
+    sets = np.zeros((3, 5), dtype=bool)
+    sets[0, [0, 2]] = True
+    sets[1, [1, 2]] = True
+    sets[2, [3]] = True
+    eq = np.asarray(tally.equivocation_pairs(sets))
+    assert list(np.nonzero(eq)[0]) == [2]
