@@ -1,0 +1,473 @@
+"""Threshold password authentication (TPA).
+
+Capability parity with the reference's 3-round PAKE-like protocol
+(reference: crypto/auth/auth.go:117-399, docs/tex/tpa.tex):
+
+- setup: a random secret S is Shamir-shared across n servers; server i
+  holds ``(x_i, y_i, v_i = g_π^{S·s_i}, salt_i)`` where
+  ``s_i = H(password, salt_i)`` (auth.go:117-154);
+- phase 0: client sends ``X = g_π^a``; each server answers
+  ``Y_i = X^{y_i}``; once k arrive the client Lagrange-combines them into
+  ``g_S = g_π^{aS}`` (auth.go:196-199, 294-329, 386-399);
+- phase 1: per-server DH — client sends ``X_i = g_S^{a'_i·s_i}``, server
+  answers ``B_i = v_i^{b_i}`` and both derive ``K_i``; HKDF key schedule,
+  HMAC confirmation tag ``N_i`` (auth.go:201-222, 331-360);
+- phase 2: server releases its AES-GCM-encrypted proof only if the MAC
+  verifies (auth.go:224-237, 362-383).
+
+Anti-brute-force: +1 s delay per retry, 10-attempt cap (auth.go:73-77,
+176-184).
+
+TPU redesign: the group is the RFC 3526 2048-bit MODP safe prime (a
+public constant, *not* the reference's baked-in prime) and every modexp
+routes through :class:`ModExpEngine`, which ships batches ≥ a threshold
+to the batched Montgomery kernel (``bftkv_tpu.ops.rsa.power_batch``) —
+the client's k-way Lagrange combine and the k X_i computations each
+become one kernel launch instead of k sequential ``big.Int.Exp`` calls
+(SURVEY.md §2 hot loops).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+import io
+import os
+import secrets as pysecrets
+import struct
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+from bftkv_tpu.crypto import sss
+from bftkv_tpu.errors import (
+    ERR_AUTHENTICATION_FAILURE,
+    ERR_DECRYPTION_FAILURE,
+    ERR_INVALID_RESPONSE,
+    ERR_MALFORMED_REQUEST,
+    ERR_NO_AUTHENTICATION_DATA,
+    ERR_TOO_MANY_ATTEMPTS,
+    Error,
+)
+from bftkv_tpu.packet import read_bigint, read_chunk, write_bigint, write_chunk
+
+__all__ = [
+    "AuthClient",
+    "AuthServer",
+    "AuthParams",
+    "ModExpEngine",
+    "generate_partial_auth_params",
+    "P",
+    "Q",
+]
+
+# RFC 3526 group 14: 2048-bit MODP safe prime (p = 2q + 1).
+P = int(
+    "FFFFFFFFFFFFFFFFC90FDAA22168C234C4C6628B80DC1CD1"
+    "29024E088A67CC74020BBEA63B139B22514A08798E3404DD"
+    "EF9519B3CD3A431B302B0A6DF25F14374FE1356D6D51C245"
+    "E485B576625E7EC6F44C42E9A637ED6B0BFF5CB6F406B7ED"
+    "EE386BFB5A899FA5AE9F24117C4B1FE649286651ECE45B3D"
+    "C2007CB8A163BF0598DA48361C55D39A69163FA8FD24CF5F"
+    "83655D23DCA3AD961C62F356208552BB9ED529077096966D"
+    "670C354E4ABC9804F1746C08CA18217C32905E462E36CE3B"
+    "E39E772C180E86039B2783A2EC07A28FB5C55DF06F4C52C9"
+    "DE2BCBF6955817183995497CEA956AE515D2261898FA0510"
+    "15728E5A8AACAA68FFFFFFFFFFFFFFFF",
+    16,
+)
+Q = (P - 1) // 2
+
+MAC_KEY_SIZE = 16
+ENC_KEY_SIZE = 16
+
+AUTH_DELAY_RATE = 1.0  # seconds added per retry (reference: auth.go:75)
+AUTH_RETRY_LIMIT = 10  # (reference: auth.go:76)
+
+
+def _hash(*args: bytes) -> bytes:
+    h = hashlib.sha256()
+    for a in args:
+        h.update(a)
+    return h.digest()
+
+
+def pi_of(password: bytes) -> int:
+    """Password → group element seed g_π (reference: auth.go:405-409)."""
+    t = int.from_bytes(_hash(password), "big")
+    return (t * t) % Q
+
+
+class ModExpEngine:
+    """Routes modexps mod P to the batched TPU kernel or the host.
+
+    Batches of at least ``min_batch`` run as one
+    ``ops.rsa.power_batch`` launch over ``(batch, 128)`` limb arrays;
+    smaller requests use host ``pow`` (a single 2048-bit modexp doesn't
+    amortize a kernel launch). ``BFTKV_TPU_MIN_MODEXP_BATCH=1`` forces
+    everything onto the device (used by tests to exercise the kernel).
+    """
+
+    _shared = None
+
+    def __init__(self, min_batch: int | None = None):
+        if min_batch is None:
+            min_batch = int(os.environ.get("BFTKV_TPU_MIN_MODEXP_BATCH", "4"))
+        self.min_batch = min_batch
+        self._dom = None
+
+    @classmethod
+    def shared(cls) -> "ModExpEngine":
+        if cls._shared is None:
+            cls._shared = cls()
+        return cls._shared
+
+    def _domain(self):
+        if self._dom is None:
+            from bftkv_tpu.ops import bigint
+
+            self._dom = bigint.MontgomeryDomain(P)
+        return self._dom
+
+    def modexp(self, pairs: list[tuple[int, int]]) -> list[int]:
+        """[(base, exp)] → [base^exp mod P], one kernel launch if batched."""
+        if len(pairs) < self.min_batch:
+            return [pow(b, e, P) for b, e in pairs]
+        from bftkv_tpu.ops import limb
+        from bftkv_tpu.ops import rsa as rsa_ops
+
+        dom = self._domain()
+        nl = dom.nlimbs
+        base = limb.ints_to_limbs([b % P for b, _ in pairs], nl)
+        exp = limb.ints_to_limbs([e for _, e in pairs], nl)
+        out = rsa_ops.power_batch(
+            base,
+            exp,
+            np.broadcast_to(dom.n, base.shape),
+            np.broadcast_to(dom.n_prime, base.shape),
+            np.broadcast_to(dom.r2, base.shape),
+            np.broadcast_to(dom.one_mont, base.shape),
+        )
+        return limb.limbs_to_ints(np.asarray(out))
+
+
+# -- key schedule / MAC / AEAD (reference: auth.go:529-578) ---------------
+
+
+def _key_sched(ks: bytes, salt: bytes) -> tuple[bytes, bytes]:
+    """HKDF-SHA256(ks, salt) → (mac_key, enc_key)."""
+    prk = hmac_mod.new(salt, ks, hashlib.sha256).digest()
+    okm = hmac_mod.new(prk, b"\x01", hashlib.sha256).digest()
+    return okm[:MAC_KEY_SIZE], okm[MAC_KEY_SIZE : MAC_KEY_SIZE + ENC_KEY_SIZE]
+
+
+def _calculate_mac(km: bytes, xi: bytes, bi: bytes) -> bytes:
+    return hmac_mod.new(km, xi + bi, hashlib.sha256).digest()
+
+
+def _encrypt(ke: bytes, plain: bytes, adata: bytes) -> tuple[bytes, bytes]:
+    nonce = os.urandom(12)  # key is never reused
+    return AESGCM(ke).encrypt(nonce, plain, adata), nonce
+
+
+def _decrypt(ke: bytes, ciphertext: bytes, adata: bytes, nonce: bytes) -> bytes:
+    return AESGCM(ke).decrypt(nonce, ciphertext, adata)
+
+
+# -- wire formats (reference: auth.go:419-527) ----------------------------
+
+
+@dataclass
+class AuthParams:
+    """One server's stored share of the auth secret."""
+
+    x: int
+    y: int
+    v: int
+    salt: bytes
+
+    def serialize(self) -> bytes:
+        buf = io.BytesIO()
+        buf.write(struct.pack(">i", self.x))
+        write_bigint(buf, self.y)
+        write_bigint(buf, self.v)
+        write_chunk(buf, self.salt)
+        return buf.getvalue()
+
+    @classmethod
+    def parse(cls, data: bytes) -> "AuthParams":
+        try:
+            r = io.BytesIO(data)
+            (x,) = struct.unpack(">i", r.read(4))
+            y = read_bigint(r)
+            v = read_bigint(r)
+            salt = read_chunk(r) or b""
+            return cls(x=x, y=y, v=v, salt=salt)
+        except Exception:
+            raise ERR_MALFORMED_REQUEST from None
+
+
+def _serialize_yi(x: int, y: int, salt: bytes) -> bytes:
+    buf = io.BytesIO()
+    buf.write(struct.pack(">i", x))
+    write_bigint(buf, y)
+    write_chunk(buf, salt)
+    return buf.getvalue()
+
+
+def _parse_yi(data: bytes) -> tuple[int, int, bytes]:
+    r = io.BytesIO(data)
+    (x,) = struct.unpack(">i", r.read(4))
+    y = read_bigint(r)
+    salt = read_chunk(r) or b""
+    return x, y, salt
+
+
+def _serialize_bi(bi: int) -> bytes:
+    buf = io.BytesIO()
+    write_bigint(buf, bi)
+    return buf.getvalue()
+
+
+def _parse_bi(data: bytes) -> int:
+    return read_bigint(io.BytesIO(data))
+
+
+def _serialize_zi(zi: bytes, nonce: bytes) -> bytes:
+    buf = io.BytesIO()
+    write_chunk(buf, zi)
+    write_chunk(buf, nonce)
+    return buf.getvalue()
+
+
+def _parse_zi(data: bytes) -> tuple[bytes, bytes]:
+    r = io.BytesIO(data)
+    zi = read_chunk(r) or b""
+    nonce = read_chunk(r) or b""
+    return zi, nonce
+
+
+# -- setup (reference: auth.go:117-154) -----------------------------------
+
+
+def generate_partial_auth_params(cred: bytes, n: int, k: int) -> list[bytes]:
+    """Shamir-share a fresh secret S; server i gets
+    ``(x_i, y_i, v_i = g_π^{S·s_i}, salt_i)``."""
+    s = pysecrets.randbelow(Q)
+    coords = sss.distribute(s, n, k, Q)
+    g_pi = pi_of(cred)
+    salt = os.urandom(16)
+    engine = ModExpEngine.shared()
+    salts = [_hash(salt, bytes([i])) for i in range(n)]
+    exps = []
+    for i in range(n):
+        si = int.from_bytes(_hash(cred, salts[i]), "big")
+        exps.append((si * s) % Q)
+    vs = engine.modexp([(g_pi, e) for e in exps])
+    out = []
+    for i in range(n):
+        params = AuthParams(x=coords[i].x, y=coords[i].y, v=vs[i], salt=salts[i])
+        out.append(params.serialize())
+    return out
+
+
+# -- server side (reference: auth.go:156-245) -----------------------------
+
+
+class AuthServer:
+    """Holds one share; answers the three phases for one session."""
+
+    def __init__(self, params_bytes: bytes, proof: bytes, *, sleep=time.sleep):
+        self.params = AuthParams.parse(params_bytes)
+        self.proof = proof
+        self.attempts = 0
+        self._keys: tuple[bytes, bytes] | None = None
+        self._mac: bytes | None = None
+        self._sleep = sleep
+        self._engine = ModExpEngine.shared()
+
+    def make_response(self, phase: int, req: bytes) -> tuple[bytes, bool]:
+        """(response, done); raises on protocol violation."""
+        try:
+            if phase == 0:
+                res = self._make_yi(req)
+                delay = self.attempts * AUTH_DELAY_RATE
+                if delay > 0:
+                    self._sleep(delay)
+                self.attempts += 1
+                if self.attempts >= AUTH_RETRY_LIMIT:
+                    raise ERR_TOO_MANY_ATTEMPTS
+                return res, False
+            if phase == 1:
+                return self._make_bi(req), False
+            if phase == 2:
+                return self._make_zi(req), True
+        except (ERR_TOO_MANY_ATTEMPTS, ERR_AUTHENTICATION_FAILURE):
+            raise
+        except Exception:
+            raise ERR_MALFORMED_REQUEST from None
+        raise ERR_MALFORMED_REQUEST
+
+    def _make_yi(self, x_bytes: bytes) -> bytes:
+        x = int.from_bytes(x_bytes, "big")
+        yi = pow(x, self.params.y, P)
+        return _serialize_yi(self.params.x, yi, self.params.salt)
+
+    def _make_bi(self, xi_bytes: bytes) -> bytes:
+        b = pysecrets.randbelow(P)
+        bi, ki = self._engine.modexp(
+            [(self.params.v, b), (int.from_bytes(xi_bytes, "big"), b)]
+        )
+        ki_bytes = ki.to_bytes((ki.bit_length() + 7) // 8, "big")
+        self._keys = _key_sched(ki_bytes, self.params.salt)
+        bi_bytes = bi.to_bytes((bi.bit_length() + 7) // 8, "big")
+        self._mac = _calculate_mac(self._keys[0], xi_bytes, bi_bytes)
+        return _serialize_bi(bi)
+
+    def _make_zi(self, ni: bytes) -> bytes:
+        if self._mac is None or not hmac_mod.compare_digest(ni, self._mac):
+            raise ERR_AUTHENTICATION_FAILURE
+        zi, nonce = _encrypt(self._keys[1], self.proof, self._mac)
+        return _serialize_zi(zi, nonce)
+
+
+# -- client side (reference: auth.go:247-399) -----------------------------
+
+
+@dataclass
+class _PartialSecret:
+    x: int
+    y: int
+    salt: bytes
+    a2: int | None = None
+    xi: bytes | None = None
+    ni: bytes | None = None
+    pi: bytes | None = None
+    keys: tuple[bytes, bytes] | None = field(default=None)
+
+
+class AuthClient:
+    """Drives the three phases against n servers, combining k responses."""
+
+    def __init__(self, cred: bytes, n: int, k: int):
+        self.password = cred
+        self.n = n
+        self.k = k
+        self.a: int | None = None
+        self.gs: int | None = None
+        self.secrets: dict[int, _PartialSecret] = {}
+        self.nresponses = 0
+        self._engine = ModExpEngine.shared()
+
+    def initiate(self, node_ids: list[int]) -> dict[int, bytes]:
+        """Phase-0 request: the same X = g_π^a to every server."""
+        self.a = pysecrets.randbelow(Q)
+        x = pow(pi_of(self.password), self.a, P)
+        xb = x.to_bytes((x.bit_length() + 7) // 8, "big")
+        return {nid: xb for nid in node_ids}
+
+    def done(self, phase: int) -> bool:
+        return phase > 2
+
+    def process_response(
+        self, phase: int, data: bytes, peer_id: int
+    ) -> dict[int, bytes] | None:
+        """Feed one server's phase response; returns the next phase's
+        per-server request map once enough responses are in.
+
+        Responses come from mutually-distrusting servers: any malformed
+        bytes fail closed as :data:`ERR_INVALID_RESPONSE`, never a raw
+        parse exception."""
+        try:
+            if phase == 0:
+                return self._process_yi(data, peer_id)
+            if phase == 1:
+                return self._process_bi(data, peer_id)
+            if phase == 2:
+                return self._process_zi(data, peer_id)
+        except Error:
+            raise
+        except Exception:
+            raise ERR_INVALID_RESPONSE from None
+        raise ERR_INVALID_RESPONSE
+
+    def get_cipher_key(self) -> bytes:
+        """hash(g_π^S, password) — the symmetric key for value wrapping
+        (reference: auth.go:285-292)."""
+        if self.gs is None:
+            raise ERR_NO_AUTHENTICATION_DATA
+        a_inv = pow(self.a, -1, Q)
+        gs = pow(self.gs, a_inv, P)
+        return _hash(gs.to_bytes((gs.bit_length() + 7) // 8, "big"), self.password)
+
+    # phase 0: collect Y_i, combine, emit X_i map
+    def _process_yi(self, data: bytes, peer_id: int) -> dict[int, bytes] | None:
+        x, yi, salt = _parse_yi(data)
+        self.secrets[peer_id] = _PartialSecret(x=x, y=yi, salt=salt)
+        if len(self.secrets) < self.k:
+            return None
+        self.gs = self._calculate_shared_secret()
+        # X_i = g_S^{a'_i·s_i} for every server — one batched launch.
+        ids = list(self.secrets)
+        exps = []
+        for nid in ids:
+            sec = self.secrets[nid]
+            sec.a2 = pysecrets.randbelow(Q)
+            si = int.from_bytes(_hash(self.password, sec.salt), "big")
+            exps.append((sec.a2 * si) % Q)
+        xis = self._engine.modexp([(self.gs, e) for e in exps])
+        out: dict[int, bytes] = {}
+        for nid, xi in zip(ids, xis):
+            xb = xi.to_bytes((xi.bit_length() + 7) // 8, "big")
+            self.secrets[nid].xi = xb
+            out[nid] = xb
+        self.nresponses = 0
+        return out
+
+    # phase 1: per-server DH confirm
+    def _process_bi(self, data: bytes, peer_id: int) -> dict[int, bytes] | None:
+        bi = _parse_bi(data)
+        sec = self.secrets.get(peer_id)
+        if sec is None:
+            raise ERR_NO_AUTHENTICATION_DATA
+        e = (self.a * sec.a2) % Q
+        ki = pow(bi, e, P)
+        ki_bytes = ki.to_bytes((ki.bit_length() + 7) // 8, "big")
+        sec.keys = _key_sched(ki_bytes, sec.salt)
+        bi_bytes = bi.to_bytes((bi.bit_length() + 7) // 8, "big")
+        sec.ni = _calculate_mac(sec.keys[0], sec.xi, bi_bytes)
+        self.nresponses += 1
+        if self.nresponses >= len(self.secrets):
+            self.nresponses = 0
+            return {nid: s.ni for nid, s in self.secrets.items()}
+        return None
+
+    # phase 2: decrypt proofs
+    def _process_zi(self, data: bytes, peer_id: int) -> dict[int, bytes] | None:
+        zi, nonce = _parse_zi(data)
+        sec = self.secrets.get(peer_id)
+        if sec is None:
+            raise ERR_NO_AUTHENTICATION_DATA
+        try:
+            sec.pi = _decrypt(sec.keys[1], zi, sec.ni, nonce)
+        except Exception:
+            raise ERR_DECRYPTION_FAILURE from None
+        self.nresponses += 1
+        if self.nresponses >= len(self.secrets):
+            return {nid: s.pi for nid, s in self.secrets.items()}
+        return None
+
+    def _calculate_shared_secret(self) -> int:
+        """g_S = Π Y_i^{λ_i} — one batched kernel launch for the k
+        exponentiations (reference: auth.go:386-399)."""
+        xs = [s.x for s in self.secrets.values()]
+        pairs = [
+            (s.y, sss.lagrange(s.x, xs, Q)) for s in self.secrets.values()
+        ]
+        terms = self._engine.modexp(pairs)
+        gs = 1
+        for t in terms:
+            gs = (gs * t) % P
+        return gs

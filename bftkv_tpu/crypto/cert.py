@@ -33,6 +33,9 @@ from bftkv_tpu.packet import read_chunk, write_chunk
 
 _MAGIC = b"BCR1"
 
+# u16 wire field bounds the signer set; merge()/add_signature enforce it.
+MAX_SIGNATURES = 0xFFFF
+
 
 def key_id(n: int, e: int) -> int:
     h = hashlib.sha256()
@@ -102,6 +105,10 @@ class Certificate:
         return list(self.signatures.keys())
 
     def add_signature(self, signer_id: int, sig: bytes) -> None:
+        # The wire count field is u16; refuse growth past it so
+        # serialize() can never fail mid-protocol on a merged cert.
+        if len(self.signatures) >= MAX_SIGNATURES and signer_id not in self.signatures:
+            return
         self.signatures[signer_id] = sig
 
     def verify_signature(self, signer: "Certificate") -> bool:
@@ -116,7 +123,11 @@ class Certificate:
         if other.id != self.id:
             raise ERR_INVALID_SIGNATURE
         for signer_id, sig in other.signatures.items():
-            self.signatures.setdefault(signer_id, sig)
+            if signer_id in self.signatures:
+                continue
+            if len(self.signatures) >= MAX_SIGNATURES:
+                break
+            self.signatures[signer_id] = sig
 
 
 def sign_certificate(cert: Certificate, signer_key: rsa.PrivateKey) -> None:

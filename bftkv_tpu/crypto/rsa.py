@@ -10,6 +10,7 @@ reference gets from Go's crypto/rsa (crypto/threshold/rsa/rsa.go:345-378).
 from __future__ import annotations
 
 import hashlib
+from collections import OrderedDict
 from dataclasses import dataclass
 
 import numpy as np
@@ -115,12 +116,21 @@ class VerifierDomain:
     the verification path.
     """
 
+    _CACHE_MAX = 4096  # moduli are attacker-influenced (embedded certs)
+
     def __init__(self, nlimbs: int = 128):
         self.nlimbs = nlimbs
-        self._cache: dict[int, bigint.MontgomeryDomain | None] = {}
+        self._cache: "OrderedDict[int, bigint.MontgomeryDomain | None]" = (
+            OrderedDict()
+        )
 
     def _dom(self, n: int) -> bigint.MontgomeryDomain | None:
-        """Montgomery domain for ``n``, or None if ``n`` is unusable."""
+        """Montgomery domain for ``n``, or None if ``n`` is unusable.
+
+        LRU-bounded: hostile packets can embed certificates with arbitrary
+        fresh moduli, so an unbounded cache would grow with attacker
+        traffic (one precomputation + dict entry per distinct n).
+        """
         dom = self._cache.get(n, False)
         if dom is False:
             try:
@@ -128,6 +138,10 @@ class VerifierDomain:
             except ValueError:
                 dom = None
             self._cache[n] = dom
+            if len(self._cache) > self._CACHE_MAX:
+                self._cache.popitem(last=False)
+        else:
+            self._cache.move_to_end(n)
         return dom
 
     def assemble(

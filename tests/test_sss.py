@@ -1,0 +1,54 @@
+"""SSS share/reconstruct properties (reference: crypto/sss/sss_test.go:54-75)."""
+
+import random
+import secrets
+
+import pytest
+
+from bftkv_tpu.crypto import sss
+
+P = (1 << 127) - 1  # Mersenne prime, plenty for tests
+
+
+def test_roundtrip_random_subsets():
+    rng = random.Random(7)
+    for _ in range(10):
+        secret = secrets.randbelow(P)
+        n, k = 10, 7
+        shares = sss.distribute(secret, n, k, P)
+        subset = rng.sample(shares, k)
+        proc = sss.SSSProcess(n, k, P, subset)
+        assert proc.secret == secret
+
+
+def test_incremental_and_duplicate_shares():
+    secret = 0xDEADBEEF
+    shares = sss.distribute(secret, 5, 3, P)
+    proc = sss.SSSProcess(5, 3, P)
+    assert proc.process_response(shares[0]) is None
+    # duplicate x must not count toward k
+    assert proc.process_response(shares[0]) is None
+    assert proc.process_response(shares[1]) is None
+    assert proc.process_response(shares[3]) == secret
+    # further shares are no-ops
+    assert proc.process_response(shares[4]) == secret
+
+
+def test_k_minus_one_insufficient():
+    secret = 12345
+    shares = sss.distribute(secret, 6, 4, P)
+    proc = sss.SSSProcess(6, 4, P, shares[:3])
+    assert proc.secret is None
+
+
+def test_lagrange_tiny():
+    # f(x) = 3 + 2x over Z_97: shares at x=1,2 are 5,7; λ weights recombine.
+    m = 97
+    xs = [1, 2]
+    s = (sss.lagrange(1, xs, m) * 5 + sss.lagrange(2, xs, m) * 7) % m
+    assert s == 3
+
+
+def test_bad_params():
+    with pytest.raises(ValueError):
+        sss.distribute(1, 3, 4, P)
