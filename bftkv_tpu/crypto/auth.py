@@ -20,11 +20,11 @@ Anti-brute-force: +1 s delay per retry, 10-attempt cap (auth.go:73-77,
 
 TPU redesign: the group is the RFC 3526 2048-bit MODP safe prime (a
 public constant, *not* the reference's baked-in prime) and every modexp
-routes through :class:`ModExpEngine`, which ships batches ≥ a threshold
-to the batched Montgomery kernel (``bftkv_tpu.ops.rsa.power_batch``) —
-the client's k-way Lagrange combine and the k X_i computations each
-become one kernel launch instead of k sequential ``big.Int.Exp`` calls
-(SURVEY.md §2 hot loops).
+routes through the shared batched engine
+(:class:`bftkv_tpu.ops.modexp.BatchModExp`) — the client's k-way
+Lagrange combine and the k X_i computations each become one kernel
+launch instead of k sequential ``big.Int.Exp`` calls (SURVEY.md §2 hot
+loops).
 """
 
 from __future__ import annotations
@@ -36,9 +36,9 @@ import os
 import secrets as pysecrets
 import struct
 import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 
-import numpy as np
 from cryptography.hazmat.primitives.ciphers.aead import AESGCM
 
 from bftkv_tpu.crypto import sss
@@ -57,7 +57,6 @@ __all__ = [
     "AuthClient",
     "AuthServer",
     "AuthParams",
-    "ModExpEngine",
     "generate_partial_auth_params",
     "P",
     "Q",
@@ -100,57 +99,13 @@ def pi_of(password: bytes) -> int:
     return (t * t) % Q
 
 
-class ModExpEngine:
-    """Routes modexps mod P to the batched TPU kernel or the host.
+def _modexp(pairs: list[tuple[int, int]]) -> list[int]:
+    """[(base, exp)] → [base^exp mod P] through the shared batched
+    engine — the client's k-way Lagrange combine and X_i fan-out each
+    become one kernel launch."""
+    from bftkv_tpu.ops.modexp import BatchModExp
 
-    Batches of at least ``min_batch`` run as one
-    ``ops.rsa.power_batch`` launch over ``(batch, 128)`` limb arrays;
-    smaller requests use host ``pow`` (a single 2048-bit modexp doesn't
-    amortize a kernel launch). ``BFTKV_TPU_MIN_MODEXP_BATCH=1`` forces
-    everything onto the device (used by tests to exercise the kernel).
-    """
-
-    _shared = None
-
-    def __init__(self, min_batch: int | None = None):
-        if min_batch is None:
-            min_batch = int(os.environ.get("BFTKV_TPU_MIN_MODEXP_BATCH", "4"))
-        self.min_batch = min_batch
-        self._dom = None
-
-    @classmethod
-    def shared(cls) -> "ModExpEngine":
-        if cls._shared is None:
-            cls._shared = cls()
-        return cls._shared
-
-    def _domain(self):
-        if self._dom is None:
-            from bftkv_tpu.ops import bigint
-
-            self._dom = bigint.MontgomeryDomain(P)
-        return self._dom
-
-    def modexp(self, pairs: list[tuple[int, int]]) -> list[int]:
-        """[(base, exp)] → [base^exp mod P], one kernel launch if batched."""
-        if len(pairs) < self.min_batch:
-            return [pow(b, e, P) for b, e in pairs]
-        from bftkv_tpu.ops import limb
-        from bftkv_tpu.ops import rsa as rsa_ops
-
-        dom = self._domain()
-        nl = dom.nlimbs
-        base = limb.ints_to_limbs([b % P for b, _ in pairs], nl)
-        exp = limb.ints_to_limbs([e for _, e in pairs], nl)
-        out = rsa_ops.power_batch(
-            base,
-            exp,
-            np.broadcast_to(dom.n, base.shape),
-            np.broadcast_to(dom.n_prime, base.shape),
-            np.broadcast_to(dom.r2, base.shape),
-            np.broadcast_to(dom.one_mont, base.shape),
-        )
-        return limb.limbs_to_ints(np.asarray(out))
+    return BatchModExp.shared().modexp(pairs, P)
 
 
 # -- key schedule / MAC / AEAD (reference: auth.go:529-578) ---------------
@@ -259,13 +214,12 @@ def generate_partial_auth_params(cred: bytes, n: int, k: int) -> list[bytes]:
     coords = sss.distribute(s, n, k, Q)
     g_pi = pi_of(cred)
     salt = os.urandom(16)
-    engine = ModExpEngine.shared()
     salts = [_hash(salt, bytes([i])) for i in range(n)]
     exps = []
     for i in range(n):
         si = int.from_bytes(_hash(cred, salts[i]), "big")
         exps.append((si * s) % Q)
-    vs = engine.modexp([(g_pi, e) for e in exps])
+    vs = _modexp([(g_pi, e) for e in exps])
     out = []
     for i in range(n):
         params = AuthParams(x=coords[i].x, y=coords[i].y, v=vs[i], salt=salts[i])
@@ -277,18 +231,31 @@ def generate_partial_auth_params(cred: bytes, n: int, k: int) -> list[bytes]:
 
 
 class AuthServer:
-    """Holds one share; answers the three phases for one session."""
+    """Holds one variable's share; answers the three phases.
+
+    One AuthServer lives as long as the stored auth data (the protocol
+    server keeps it per protected variable), so the anti-brute-force
+    counter spans client sessions (reference: auth.go:73-77,176-184).
+    Per-session DH state (keys, MAC) is keyed by ``session`` — the
+    caller passes a stable id per client connection — so concurrent
+    logins don't clobber each other.
+    """
+
+    _MAX_SESSIONS = 1024
 
     def __init__(self, params_bytes: bytes, proof: bytes, *, sleep=time.sleep):
         self.params = AuthParams.parse(params_bytes)
         self.proof = proof
         self.attempts = 0
-        self._keys: tuple[bytes, bytes] | None = None
-        self._mac: bytes | None = None
+        # session -> (mac_key, enc_key, mac); LRU-bounded
+        self._sessions: "OrderedDict[int, tuple[bytes, bytes, bytes]]" = (
+            OrderedDict()
+        )
         self._sleep = sleep
-        self._engine = ModExpEngine.shared()
 
-    def make_response(self, phase: int, req: bytes) -> tuple[bytes, bool]:
+    def make_response(
+        self, phase: int, req: bytes, session: int = 0
+    ) -> tuple[bytes, bool]:
         """(response, done); raises on protocol violation."""
         try:
             if phase == 0:
@@ -301,35 +268,44 @@ class AuthServer:
                     raise ERR_TOO_MANY_ATTEMPTS
                 return res, False
             if phase == 1:
-                return self._make_bi(req), False
+                return self._make_bi(req, session), False
             if phase == 2:
-                return self._make_zi(req), True
+                return self._make_zi(req, session), True
         except (ERR_TOO_MANY_ATTEMPTS, ERR_AUTHENTICATION_FAILURE):
             raise
         except Exception:
             raise ERR_MALFORMED_REQUEST from None
         raise ERR_MALFORMED_REQUEST
 
+    def reset_attempts(self) -> None:
+        """Successful authentication clears the retry penalty."""
+        self.attempts = 0
+
     def _make_yi(self, x_bytes: bytes) -> bytes:
         x = int.from_bytes(x_bytes, "big")
         yi = pow(x, self.params.y, P)
         return _serialize_yi(self.params.x, yi, self.params.salt)
 
-    def _make_bi(self, xi_bytes: bytes) -> bytes:
+    def _make_bi(self, xi_bytes: bytes, session: int) -> bytes:
         b = pysecrets.randbelow(P)
-        bi, ki = self._engine.modexp(
+        bi, ki = _modexp(
             [(self.params.v, b), (int.from_bytes(xi_bytes, "big"), b)]
         )
         ki_bytes = ki.to_bytes((ki.bit_length() + 7) // 8, "big")
-        self._keys = _key_sched(ki_bytes, self.params.salt)
+        km, ke = _key_sched(ki_bytes, self.params.salt)
         bi_bytes = bi.to_bytes((bi.bit_length() + 7) // 8, "big")
-        self._mac = _calculate_mac(self._keys[0], xi_bytes, bi_bytes)
+        mac = _calculate_mac(km, xi_bytes, bi_bytes)
+        self._sessions[session] = (km, ke, mac)
+        if len(self._sessions) > self._MAX_SESSIONS:
+            self._sessions.popitem(last=False)
         return _serialize_bi(bi)
 
-    def _make_zi(self, ni: bytes) -> bytes:
-        if self._mac is None or not hmac_mod.compare_digest(ni, self._mac):
+    def _make_zi(self, ni: bytes, session: int) -> bytes:
+        state = self._sessions.get(session)
+        if state is None or not hmac_mod.compare_digest(ni, state[2]):
             raise ERR_AUTHENTICATION_FAILURE
-        zi, nonce = _encrypt(self._keys[1], self.proof, self._mac)
+        _km, ke, mac = state
+        zi, nonce = _encrypt(ke, self.proof, mac)
         return _serialize_zi(zi, nonce)
 
 
@@ -358,8 +334,10 @@ class AuthClient:
         self.a: int | None = None
         self.gs: int | None = None
         self.secrets: dict[int, _PartialSecret] = {}
-        self.nresponses = 0
-        self._engine = ModExpEngine.shared()
+        # Per-phase dedup of responders; replays and stragglers from an
+        # earlier phase must never count toward a later one.
+        self._responded: dict[int, set[int]] = {1: set(), 2: set()}
+        self._emitted: set[int] = set()
 
     def initiate(self, node_ids: list[int]) -> dict[int, bytes]:
         """Phase-0 request: the same X = g_π^a to every server."""
@@ -404,6 +382,11 @@ class AuthClient:
 
     # phase 0: collect Y_i, combine, emit X_i map
     def _process_yi(self, data: bytes, peer_id: int) -> dict[int, bytes] | None:
+        if self.gs is not None:
+            # Straggler after the k-th response: the shared secret and
+            # per-server blinding are already fixed; recomputing them
+            # here would invalidate the in-flight phase-1 state.
+            return None
         x, yi, salt = _parse_yi(data)
         self.secrets[peer_id] = _PartialSecret(x=x, y=yi, salt=salt)
         if len(self.secrets) < self.k:
@@ -417,13 +400,13 @@ class AuthClient:
             sec.a2 = pysecrets.randbelow(Q)
             si = int.from_bytes(_hash(self.password, sec.salt), "big")
             exps.append((sec.a2 * si) % Q)
-        xis = self._engine.modexp([(self.gs, e) for e in exps])
+        xis = _modexp([(self.gs, e) for e in exps])
         out: dict[int, bytes] = {}
         for nid, xi in zip(ids, xis):
             xb = xi.to_bytes((xi.bit_length() + 7) // 8, "big")
             self.secrets[nid].xi = xb
             out[nid] = xb
-        self.nresponses = 0
+        self._emitted.add(0)
         return out
 
     # phase 1: per-server DH confirm
@@ -432,15 +415,17 @@ class AuthClient:
         sec = self.secrets.get(peer_id)
         if sec is None:
             raise ERR_NO_AUTHENTICATION_DATA
+        if 1 in self._emitted or peer_id in self._responded[1]:
+            return None  # phase already complete, or a replay
         e = (self.a * sec.a2) % Q
         ki = pow(bi, e, P)
         ki_bytes = ki.to_bytes((ki.bit_length() + 7) // 8, "big")
         sec.keys = _key_sched(ki_bytes, sec.salt)
         bi_bytes = bi.to_bytes((bi.bit_length() + 7) // 8, "big")
         sec.ni = _calculate_mac(sec.keys[0], sec.xi, bi_bytes)
-        self.nresponses += 1
-        if self.nresponses >= len(self.secrets):
-            self.nresponses = 0
+        self._responded[1].add(peer_id)
+        if self._responded[1] >= set(self.secrets):
+            self._emitted.add(1)
             return {nid: s.ni for nid, s in self.secrets.items()}
         return None
 
@@ -450,12 +435,15 @@ class AuthClient:
         sec = self.secrets.get(peer_id)
         if sec is None:
             raise ERR_NO_AUTHENTICATION_DATA
+        if 2 in self._emitted or peer_id in self._responded[2]:
+            return None  # phase already complete, or a replay
         try:
             sec.pi = _decrypt(sec.keys[1], zi, sec.ni, nonce)
         except Exception:
             raise ERR_DECRYPTION_FAILURE from None
-        self.nresponses += 1
-        if self.nresponses >= len(self.secrets):
+        self._responded[2].add(peer_id)
+        if self._responded[2] >= set(self.secrets):
+            self._emitted.add(2)
             return {nid: s.pi for nid, s in self.secrets.items()}
         return None
 
@@ -466,7 +454,7 @@ class AuthClient:
         pairs = [
             (s.y, sss.lagrange(s.x, xs, Q)) for s in self.secrets.values()
         ]
-        terms = self._engine.modexp(pairs)
+        terms = _modexp(pairs)
         gs = 1
         for t in terms:
             gs = (gs * t) % P

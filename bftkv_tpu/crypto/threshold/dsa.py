@@ -1,0 +1,96 @@
+"""Threshold DSA: the mod-p instantiation of the dealerless core.
+
+Capability parity with the reference (crypto/threshold/dsa/dsa.go):
+partial R is ``g^{a_i} mod p``; the combine is
+``r = (Π r_i^{λ_i})^{(Σ v_i λ_i)^{-1}} mod p mod q`` — here the Π term's
+exponentiations run as one batched TPU modexp launch (dsa.go:27-52).
+"""
+
+from __future__ import annotations
+
+import io
+from dataclasses import dataclass
+
+from bftkv_tpu.crypto import sss
+from bftkv_tpu.crypto.threshold import ThresholdAlgo
+from bftkv_tpu.crypto.threshold.dsa_core import DsaContext, PartialR
+from bftkv_tpu.ops.modexp import BatchModExp
+from bftkv_tpu.packet import read_bigint, write_bigint
+
+__all__ = ["DSAPrivateKey", "DSAGroup", "new", "generate"]
+
+
+@dataclass(frozen=True)
+class DSAPrivateKey:
+    p: int
+    q: int
+    g: int
+    x: int  # private
+    y: int  # public = g^x mod p
+
+
+def generate(key_size: int = 2048) -> DSAPrivateKey:
+    """FFC parameter + key generation via the host crypto library."""
+    from cryptography.hazmat.primitives.asymmetric import dsa as _cdsa
+
+    k = _cdsa.generate_private_key(key_size)
+    nums = k.private_numbers()
+    pub = nums.public_numbers
+    par = pub.parameter_numbers
+    return DSAPrivateKey(p=par.p, q=par.q, g=par.g, x=nums.x, y=pub.y)
+
+
+class _DSAGroupOps:
+    def __init__(self, p: int, q: int, g: int):
+        self.p = p
+        self.q = q
+        self.g = g
+        self._engine = BatchModExp.shared()
+
+    def calculate_partial_r(self, ai: int) -> bytes:
+        ri = pow(self.g, ai, self.p)
+        return ri.to_bytes((ri.bit_length() + 7) // 8 or 1, "big")
+
+    def calculate_r(self, rs: list[PartialR]) -> int:
+        """One kernel launch for the 2t Lagrange exponentiations
+        (reference: dsa.go:33-52)."""
+        xs = [pr.x for pr in rs]
+        pairs = []
+        v = 0
+        for pr in rs:
+            lam = sss.lagrange(pr.x, xs, self.q)
+            pairs.append((int.from_bytes(pr.ri, "big"), lam))
+            v = (v + pr.vi * lam) % self.q
+        terms = self._engine.modexp(pairs, self.p)
+        r = 1
+        for t in terms:
+            r = (r * t) % self.p
+        v_inv = pow(v, -1, self.q)
+        return pow(r, v_inv, self.p) % self.q
+
+    def subgroup_order(self) -> int:
+        return self.q
+
+    def serialize(self, buf: io.BytesIO) -> None:
+        write_bigint(buf, self.p)
+        write_bigint(buf, self.q)
+        write_bigint(buf, self.g)
+
+    def os2i(self, os: bytes) -> int:
+        order_size = (self.q.bit_length() + 7) // 8
+        return int.from_bytes(os[:order_size], "big")
+
+
+class DSAGroup:
+    def parse_key(self, key: DSAPrivateKey):
+        return _DSAGroupOps(key.p, key.q, key.g), key.x
+
+    def parse_params(self, r: io.BytesIO) -> _DSAGroupOps:
+        p = read_bigint(r)
+        q = read_bigint(r)
+        g = read_bigint(r)
+        return _DSAGroupOps(p, q, g)
+
+
+def new(crypt) -> DsaContext:
+    return DsaContext(crypt, DSAGroup(), ThresholdAlgo.DSA)

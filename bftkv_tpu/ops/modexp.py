@@ -1,0 +1,93 @@
+"""Shared batched-modexp engine: route big-int exponentiations to the TPU.
+
+Every distributed-crypto subsystem in the reference bottoms out in
+``big.Int.Exp`` loops — TPA's DH rounds (crypto/auth/auth.go), threshold
+RSA's per-fragment signing (crypto/threshold/rsa/rsa.go:140-178), and
+threshold DSA's partial-R combination (crypto/threshold/dsa/dsa.go:33-52).
+This engine replaces those per-item loops with one
+``ops.rsa.power_batch`` launch per request batch.
+
+Policy: batches below ``min_batch`` (default 4, override with
+``BFTKV_TPU_MIN_MODEXP_BATCH``) run as host ``pow`` — a single modexp
+doesn't amortize a kernel launch. Per-modulus Montgomery precomputation
+is LRU-bounded since moduli can be influenced by remote peers.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+
+import numpy as np
+
+__all__ = ["BatchModExp"]
+
+
+class BatchModExp:
+    _shared = None
+    _DOM_CACHE_MAX = 64
+
+    def __init__(self, min_batch: int | None = None):
+        if min_batch is None:
+            min_batch = int(os.environ.get("BFTKV_TPU_MIN_MODEXP_BATCH", "4"))
+        self.min_batch = min_batch
+        self._domains: "OrderedDict[tuple[int, int], object]" = OrderedDict()
+
+    @classmethod
+    def shared(cls) -> "BatchModExp":
+        if cls._shared is None:
+            cls._shared = cls()
+        return cls._shared
+
+    def _domain(self, n: int, nlimbs: int):
+        from bftkv_tpu.ops import bigint
+
+        key = (n, nlimbs)
+        dom = self._domains.get(key)
+        if dom is None:
+            dom = bigint.MontgomeryDomain(n, nlimbs)
+            self._domains[key] = dom
+            if len(self._domains) > self._DOM_CACHE_MAX:
+                self._domains.popitem(last=False)
+        else:
+            self._domains.move_to_end(key)
+        return dom
+
+    # Exponents can outgrow the modulus (threshold-RSA fragments double
+    # in width per tree level — rsa.go:97-117). Past this limb width the
+    # window loop dominates and host pow wins; cap the device path.
+    MAX_EXP_LIMBS = 256  # 4096 bits
+
+    def modexp(self, pairs: list[tuple[int, int]], n: int) -> list[int]:
+        """[(base, exp)] → [base^exp mod n] — one kernel launch when the
+        batch is big enough and ``n`` is odd (Montgomery-compatible)."""
+        if not pairs:
+            return []
+        if len(pairs) < self.min_batch or n % 2 == 0 or n <= 1:
+            return [pow(b % n, e, n) for b, e in pairs]
+        from bftkv_tpu.ops import limb
+        from bftkv_tpu.ops import rsa as rsa_ops
+
+        nlimbs = limb.nlimbs_for_bits(n.bit_length())
+        max_e = max(e for _, e in pairs)
+        e_limbs = max(limb.nlimbs_for_bits(max_e.bit_length()), 1)
+        if e_limbs > self.MAX_EXP_LIMBS:
+            return [pow(b % n, e, n) for b, e in pairs]
+        # Bucket the exponent width (64/128/256 limbs) so varying widths
+        # reuse a handful of compiled programs instead of one each.
+        for bucket in (64, 128, 256):
+            if e_limbs <= bucket:
+                e_limbs = bucket
+                break
+        dom = self._domain(n, nlimbs)
+        base = limb.ints_to_limbs([b % n for b, _ in pairs], nlimbs)
+        exp = limb.ints_to_limbs([e for _, e in pairs], e_limbs)
+        out = rsa_ops.power_batch(
+            base,
+            exp,
+            np.broadcast_to(dom.n, base.shape),
+            np.broadcast_to(dom.n_prime, base.shape),
+            np.broadcast_to(dom.r2, base.shape),
+            np.broadcast_to(dom.one_mont, base.shape),
+        )
+        return limb.limbs_to_ints(np.asarray(out))

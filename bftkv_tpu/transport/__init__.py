@@ -1,0 +1,189 @@
+"""Transport: the 13-command RPC fabric between mutually-distrusting nodes.
+
+Capability parity with the reference's transport core
+(reference: transport/transport.go):
+
+- command enum and URL mapping under ``/bftkv/v1/`` (transport.go:14-35);
+- the shared **multicast fan-out**: one worker per peer doing
+  POST → decrypt → nonce check, fan-in over a queue, with
+  **callback-driven early termination** — returning True from the
+  callback stops consuming; this is how quorum thresholds short-circuit
+  network waits (transport.go:67-137);
+- single-payload mode encrypts once to the whole recipient set;
+  ``multicast_m`` encrypts per-peer (transport.go:101-109);
+- every payload crosses the wire sign-then-encrypted with a nonce the
+  responder must echo (replay protection, transport.go:121-124).
+
+Byzantine-boundary note (SURVEY.md §5): replicas distrust each other, so
+inter-replica traffic stays ordinary RPC — ICI/DCN collectives apply
+only *inside* one replica's accelerator pool. This module is the
+cross-replica backend; the TPU work it feeds is batched downstream at
+the crypto layer.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Callable, Protocol
+
+from bftkv_tpu.errors import new_error
+
+__all__ = [
+    "JOIN",
+    "LEAVE",
+    "TIME",
+    "READ",
+    "WRITE",
+    "SIGN",
+    "AUTH",
+    "SETAUTH",
+    "DISTRIBUTE",
+    "DISTSIGN",
+    "REGISTER",
+    "REVOKE",
+    "NOTIFY",
+    "PREFIX",
+    "COMMAND_NAMES",
+    "MulticastResponse",
+    "Transport",
+    "TransportServer",
+    "multicast",
+]
+
+# Command enum (reference: transport.go:14-28).
+JOIN = 0
+LEAVE = 1
+TIME = 2
+READ = 3
+WRITE = 4
+SIGN = 5
+AUTH = 6
+SETAUTH = 7
+DISTRIBUTE = 8
+DISTSIGN = 9
+REGISTER = 10
+REVOKE = 11
+NOTIFY = 12
+
+PREFIX = "/bftkv/v1/"
+
+COMMAND_NAMES = {
+    JOIN: "join",
+    LEAVE: "leave",
+    TIME: "time",
+    READ: "read",
+    WRITE: "write",
+    SIGN: "sign",
+    AUTH: "auth",
+    SETAUTH: "setauth",
+    DISTRIBUTE: "distribute",
+    DISTSIGN: "distsign",
+    REGISTER: "register",
+    REVOKE: "revoke",
+    NOTIFY: "notify",
+}
+COMMANDS_BY_NAME = {v: k for k, v in COMMAND_NAMES.items()}
+
+ERR_TRANSPORT_SECURITY = new_error("transport: transport security error")
+ERR_NONCE_MISMATCH = new_error("transport: nonce mismatch")
+ERR_SERVER_ERROR = new_error("transport: server error")
+ERR_NO_ADDRESS = new_error("transport: no address")
+
+
+@dataclass
+class MulticastResponse:
+    """(reference: transport.go:44-48)."""
+
+    peer: object
+    data: bytes | None
+    err: Exception | None
+
+
+class TransportServer(Protocol):
+    """(reference: transport.go:50-52)."""
+
+    def handler(self, cmd: int, data: bytes) -> bytes | None: ...
+
+
+class Transport(Protocol):
+    """(reference: transport.go:54-65)."""
+
+    def multicast(
+        self, cmd: int, peers: list, data: bytes | None, cb: Callable
+    ) -> None: ...
+
+    def multicast_m(
+        self, cmd: int, peers: list, mdata: list[bytes], cb: Callable
+    ) -> None: ...
+
+    def start(self, o: TransportServer, addr: str) -> None: ...
+
+    def stop(self) -> None: ...
+
+    def post(self, addr: str, msg: bytes) -> bytes: ...
+
+    def generate_random(self) -> bytes: ...
+
+    def encrypt(self, peers: list, plain: bytes, nonce: bytes) -> bytes: ...
+
+    def decrypt(self, data: bytes) -> tuple[bytes, object, bytes]: ...
+
+
+def multicast(
+    tr: Transport,
+    cmd: int,
+    peers: list,
+    mdata: list[bytes | None],
+    cb: Callable[[MulticastResponse], bool] | None,
+) -> None:
+    """Shared fan-out helper (reference: transport.go:67-137).
+
+    ``mdata`` with one element = single-payload mode (encrypt once to
+    the whole peer set); len(mdata) == len(peers) = per-peer payloads.
+    The callback runs on the caller's thread; returning True stops the
+    fan-in (in-flight posts complete in their workers and are dropped).
+    """
+    if not peers:
+        return
+    name = COMMAND_NAMES.get(cmd)
+    if name is None:
+        raise new_error("transport: unknown command")
+    ch: "queue.Queue[MulticastResponse]" = queue.Queue()
+    cipher = None
+    nonce = None
+    launched = 0
+    for i, peer in enumerate(peers):
+        if i < len(mdata):
+            nonce = tr.generate_random()
+            try:
+                recipients = peers[i : i + len(peers) - len(mdata) + 1]
+                cipher = tr.encrypt(recipients, mdata[i] or b"", nonce)
+            except Exception as e:
+                ch.put(MulticastResponse(peer, None, e))
+                launched += 1
+                continue
+
+        def work(peer=peer, cipher=cipher, nonce=nonce):
+            addr = getattr(peer, "address", "")
+            if not addr:
+                ch.put(MulticastResponse(peer, None, ERR_NO_ADDRESS()))
+                return
+            try:
+                res = tr.post(addr + PREFIX + name, cipher)
+                plain, _sender, echoed = tr.decrypt(res)
+                if echoed != nonce:
+                    ch.put(MulticastResponse(peer, None, ERR_NONCE_MISMATCH()))
+                    return
+                ch.put(MulticastResponse(peer, plain, None))
+            except Exception as e:
+                ch.put(MulticastResponse(peer, None, e))
+
+        threading.Thread(target=work, daemon=True).start()
+        launched += 1
+
+    for _ in range(launched):
+        mr = ch.get()
+        if cb is not None and cb(mr):
+            break  # early exit; remaining posts finish in their threads

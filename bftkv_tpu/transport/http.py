@@ -1,0 +1,151 @@
+"""HTTP transport: POST bodies under ``/bftkv/v1/<cmd>``, errors tunneled
+in the ``x-error`` response header.
+
+Capability parity with the reference (transport/http/http.go): 5 s
+connect / 10 s response timeouts (http.go:39-50), path→command dispatch
+(http.go:97-149), interned errors round-tripped via ``x-error``
+(http.go:59-66), crypto delegation for the session layer
+(http.go:151-161). The server is a threading HTTP server — one OS
+thread per in-flight request, matching the reference's ``net/http``
+concurrency model (many servers run in one test process).
+"""
+
+from __future__ import annotations
+
+import threading
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from bftkv_tpu import transport as tp
+from bftkv_tpu.errors import Error, error_from_string
+
+__all__ = ["TrHTTP", "MalTrHTTP"]
+
+CONNECT_TIMEOUT = 5.0
+RESPONSE_TIMEOUT = 10.0
+NONCE_SIZE = 8
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # quiet; observability lives upstream
+        pass
+
+    def do_POST(self):
+        path = self.path.lower()
+        if not path.startswith(tp.PREFIX):
+            self.send_error(404)
+            return
+        cmd = tp.COMMANDS_BY_NAME.get(path[len(tp.PREFIX) :])
+        if cmd is None:
+            self.send_error(404)
+            return
+        try:
+            length = int(self.headers.get("content-length", "0"))
+            body = self.rfile.read(length)
+        except Exception:
+            self.send_error(400)
+            return
+        try:
+            res = self.server.owner_handler(cmd, body)
+        except Error as e:
+            self.send_response(500)
+            self.send_header("x-error", e.message)
+            self.send_header("content-length", "0")
+            self.end_headers()
+            return
+        except Exception:
+            self.send_response(500)
+            self.send_header("x-error", "internal error")
+            self.send_header("content-length", "0")
+            self.end_headers()
+            return
+        res = res or b""
+        self.send_response(200)
+        self.send_header("content-type", "application/octet-stream")
+        self.send_header("content-length", str(len(res)))
+        self.end_headers()
+        self.wfile.write(res)
+
+
+class TrHTTP:
+    """(reference: http.go:21-95)."""
+
+    def __init__(self, security):
+        self.security = security
+        self._server: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- client side ------------------------------------------------------
+    def post(self, addr: str, msg: bytes) -> bytes:
+        req = urllib.request.Request(
+            addr,
+            data=msg or b"",
+            headers={"content-type": "application/octet-stream"},
+            method="POST",
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=RESPONSE_TIMEOUT) as res:
+                return res.read()
+        except urllib.error.HTTPError as e:
+            errs = e.headers.get("x-error") if e.headers else None
+            e.close()
+            if e.code == 500 and errs:
+                raise error_from_string(errs) from None
+            raise tp.ERR_SERVER_ERROR from None
+        except Error:
+            raise
+        except Exception:
+            raise tp.ERR_SERVER_ERROR from None
+
+    def multicast(self, cmd: int, peers: list, data: bytes | None, cb) -> None:
+        tp.multicast(self, cmd, peers, [data], cb)
+
+    def multicast_m(self, cmd: int, peers: list, mdata: list, cb) -> None:
+        tp.multicast(self, cmd, peers, mdata, cb)
+
+    # -- server side ------------------------------------------------------
+    def start(self, o, addr: str) -> None:
+        """``addr`` is ``host:port`` (the listen side of the node's
+        certificate address)."""
+        host, _, port = addr.rpartition(":")
+        self._server = ThreadingHTTPServer(
+            (host or "127.0.0.1", int(port)), _Handler
+        )
+        self._server.owner_handler = self._dispatch(o)
+        self._server.daemon_threads = True
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def _dispatch(self, o):
+        return o.handler
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    # -- session-layer delegation (reference: http.go:151-161) ------------
+    def generate_random(self) -> bytes:
+        from bftkv_tpu.crypto import rng
+
+        return rng.generate_random(NONCE_SIZE)
+
+    def encrypt(self, peers: list, plain: bytes, nonce: bytes) -> bytes:
+        return self.security.message.encrypt(peers, plain, nonce)
+
+    def decrypt(self, data: bytes):
+        return self.security.message.decrypt(data)
+
+
+class MalTrHTTP(TrHTTP):
+    """Routes to a ``mal_handler`` when present — the Byzantine test hook
+    (reference: transport/maltransport.go:10-12, http/malhttp.go:21-41)."""
+
+    def _dispatch(self, o):
+        return getattr(o, "mal_handler", None) or o.handler

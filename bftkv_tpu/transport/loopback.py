@@ -1,0 +1,82 @@
+"""In-process loopback transport: direct handler calls, no sockets.
+
+The reference exercises its protocol state machines without transport by
+direct calls (the tier-2 "fake backend" pattern —
+reference: crypto/threshold/dsa/test_utils/test_utils.go:28-54,
+protocol/revoke_test.go:27; SURVEY.md §4). This transport makes that a
+first-class backend: the full session layer (sign-then-encrypt, nonce
+echo) still runs, only the HTTP hop is elided — so protocol tests and
+crypto-bound benchmarks measure the framework, not socket overhead.
+"""
+
+from __future__ import annotations
+
+from bftkv_tpu import transport as tp
+from bftkv_tpu.errors import new_error
+
+__all__ = ["LoopbackNet", "TrLoopback"]
+
+ERR_UNREACHABLE = new_error("transport: peer unreachable")
+
+
+class LoopbackNet:
+    """A process-wide registry: address → TransportServer."""
+
+    def __init__(self):
+        self.servers: dict[str, object] = {}
+
+    def register(self, addr: str, handler) -> None:
+        self.servers[addr] = handler
+
+    def unregister(self, addr: str) -> None:
+        self.servers.pop(addr, None)
+
+
+class TrLoopback:
+    """Same interface as TrHTTP over a shared :class:`LoopbackNet`."""
+
+    def __init__(self, security, net: LoopbackNet):
+        self.security = security
+        self.net = net
+        self._addr: str | None = None
+
+    # -- client side ------------------------------------------------------
+    def post(self, addr: str, msg: bytes) -> bytes:
+        if not addr.startswith("loop://"):
+            raise ERR_UNREACHABLE
+        base, _, name = addr[len("loop://") :].rpartition(tp.PREFIX)
+        handler = self.net.servers.get(base)
+        if handler is None:
+            raise ERR_UNREACHABLE
+        cmd = tp.COMMANDS_BY_NAME.get(name)
+        if cmd is None:
+            raise ERR_UNREACHABLE
+        return handler(cmd, msg) or b""
+
+    def multicast(self, cmd: int, peers: list, data: bytes | None, cb) -> None:
+        tp.multicast(self, cmd, peers, [data], cb)
+
+    def multicast_m(self, cmd: int, peers: list, mdata: list, cb) -> None:
+        tp.multicast(self, cmd, peers, mdata, cb)
+
+    # -- server side ------------------------------------------------------
+    def start(self, o, addr: str) -> None:
+        self._addr = addr
+        self.net.register(addr, o.handler)
+
+    def stop(self) -> None:
+        if self._addr is not None:
+            self.net.unregister(self._addr)
+            self._addr = None
+
+    # -- session layer ----------------------------------------------------
+    def generate_random(self) -> bytes:
+        from bftkv_tpu.crypto import rng
+
+        return rng.generate_random(8)
+
+    def encrypt(self, peers: list, plain: bytes, nonce: bytes) -> bytes:
+        return self.security.message.encrypt(peers, plain, nonce)
+
+    def decrypt(self, data: bytes):
+        return self.security.message.decrypt(data)

@@ -120,3 +120,74 @@ def test_k_minus_one_is_insufficient():
 def test_params_roundtrip():
     p = auth.AuthParams(x=3, y=12345, v=67890, salt=b"salty")
     assert auth.AuthParams.parse(p.serialize()) == p
+
+
+def test_stragglers_and_duplicates_ignored():
+    """Late phase-0 responses and replayed phase-1/2 responses must not
+    corrupt the combined state (all n respond; k < n)."""
+    password = b"pw"
+    n, k = 5, 3
+    servers = make_servers(password, n, k)
+    client = auth.AuthClient(password, n, k)
+    reqs = client.initiate(list(servers))
+    # feed ALL n phase-0 responses (no early exit)
+    nxt = None
+    for nid, req in reqs.items():
+        res, _ = servers[nid].make_response(0, req)
+        out = client.process_response(0, res, nid)
+        if out is not None:
+            nxt = out  # keep the FIRST map; stragglers keep arriving
+    assert nxt is not None and len(nxt) == k
+    # phase 1 with a duplicate of every response
+    n_map = None
+    for nid, req in nxt.items():
+        res, _ = servers[nid].make_response(1, req)
+        out = client.process_response(1, res, nid)
+        dup = client.process_response(1, res, nid)  # replay
+        assert dup is None or out is not None
+        n_map = out or n_map
+    assert n_map is not None
+    assert all(v is not None for v in n_map.values())
+    # phase 2 completes with intact MACs
+    proofs = None
+    for nid, ni in n_map.items():
+        res, _ = servers[nid].make_response(2, ni)
+        out = client.process_response(2, res, nid)
+        proofs = out or proofs
+    assert proofs is not None
+    for nid, proof in proofs.items():
+        assert proof == b"proof-%d" % nid
+
+
+def test_concurrent_sessions_do_not_clobber():
+    """Two clients interleaved against the same AuthServer state."""
+    password = b"pw"
+    servers = make_servers(password, 1, 1)
+    s = servers[0]
+    c1 = auth.AuthClient(password, 1, 1)
+    c2 = auth.AuthClient(password, 1, 1)
+    x1 = c1.initiate([0])[0]
+    x2 = c2.initiate([0])[0]
+    m1 = c1.process_response(0, s.make_response(0, x1, session=1)[0], 0)
+    m2 = c2.process_response(0, s.make_response(0, x2, session=2)[0], 0)
+    # interleave phase 1: session 2 runs between session 1's phases
+    n1 = c1.process_response(1, s.make_response(1, m1[0], session=1)[0], 0)
+    n2 = c2.process_response(1, s.make_response(1, m2[0], session=2)[0], 0)
+    p1 = c1.process_response(2, s.make_response(2, n1[0], session=1)[0], 0)
+    p2 = c2.process_response(2, s.make_response(2, n2[0], session=2)[0], 0)
+    assert p1[0] == b"proof-0" and p2[0] == b"proof-0"
+
+
+def test_attempt_counter_spans_sessions():
+    """attempts accrues per stored variable, not per client session."""
+    servers = make_servers(b"pw", 1, 1)
+    s = servers[0]
+    for i in range(auth.AUTH_RETRY_LIMIT - 1):
+        c = auth.AuthClient(b"pw", 1, 1)
+        s.make_response(0, c.initiate([0])[0], session=i)
+    c = auth.AuthClient(b"pw", 1, 1)
+    with pytest.raises(ERR_TOO_MANY_ATTEMPTS):
+        s.make_response(0, c.initiate([0])[0], session=99)
+    s.reset_attempts()
+    res, _ = s.make_response(0, c.initiate([0])[0], session=100)
+    assert res

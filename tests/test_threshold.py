@@ -1,0 +1,273 @@
+"""Threshold RSA/DSA/ECDSA, simulated multi-node without transport
+(reference test strategy: crypto/threshold/rsa/rsa_test.go,
+dsa/dsa_test.go + test_utils, ecdsa/ecdsa_test.go — SURVEY.md §4 tier 2)."""
+
+import random
+import secrets
+
+import pytest
+
+from bftkv_tpu import errors
+from bftkv_tpu.crypto import cert as certmod
+from bftkv_tpu.crypto import ec
+from bftkv_tpu.crypto import rsa as rsakeys
+from bftkv_tpu.crypto import new_crypto
+from bftkv_tpu.crypto.threshold import (
+    ThresholdAlgo,
+    ThresholdInstance,
+    parse_params,
+    serialize_params,
+)
+from bftkv_tpu.crypto.threshold import dsa as tdsa
+from bftkv_tpu.crypto.threshold import ecdsa as tecdsa
+from bftkv_tpu.crypto.threshold import rsa as trsa
+
+RNG = random.Random(42)
+
+
+def _rng(bound):
+    return RNG.randrange(bound)
+
+
+# -- RSA tree unit tests (reference: rsa_test.go:31-102) -------------------
+
+
+def test_split_key_sums_back():
+    d = secrets.randbits(512)
+    frags = trsa._split_key(d, 7, _rng)
+    assert sum(frags) == d
+    assert len(frags) == 7
+
+
+def test_key_tree_covers_k_subsets():
+    """Any k-subset of servers holds fragments that recombine to d along
+    the exclusion tree (the property behind rsa.go:75-127): the value at
+    a tree node is recoverable from a holder set S iff for every child i
+    of the node, either i ∈ S holds the fragment directly or S recovers
+    child i's subtree."""
+    n, k = 5, 3
+    d = secrets.randbits(64)
+    tree = trsa.make_key_tree(d, 0, n, k, _rng)
+    per_server = []
+    for i in range(n):
+        keys = {}
+        trsa.collect_keys(tree, i, keys)
+        per_server.append(keys)
+        assert keys, f"server {i} holds no fragments"
+
+    def recover(node, holders):
+        if node.children is None:
+            return None  # leaf value is only reachable via its holder
+        total = 0
+        for i, child in node.children.items():
+            if i in holders:
+                assert per_server[i][node.idx] == child.di
+                total += child.di
+            else:
+                sub = recover(child, holders)
+                if sub is None:
+                    return None
+                total += sub
+        return total
+
+    import itertools
+
+    for subset in itertools.combinations(range(n), k):
+        assert recover(tree, set(subset)) == d, subset
+    # k-1 servers must NOT recover
+    for subset in itertools.combinations(range(n), k - 1):
+        assert recover(tree, set(subset)) is None, subset
+
+
+def sim_rsa_sign(key, n, k, subset, tbs=b"threshold me"):
+    """Drive dealer → per-server sign → client combine with direct calls."""
+    ctx = trsa.RSAThreshold(rng=_rng)
+
+    class FakeNode:
+        def __init__(self, i):
+            self.id = i
+
+    nodes = [FakeNode(i) for i in range(n)]
+    shares, algo = ctx.distribute(key, nodes, k)
+    assert algo == ThresholdAlgo.RSA
+    proc = ctx.new_process(tbs, algo, "sha256")
+    for _round in range(10):
+        target, req = proc.make_request()
+        if req is None:
+            break
+        sig = None
+        for node in target:
+            if node.id not in subset:
+                continue
+            res = ctx.sign(shares[node.id], req, 0xC11E47, node.id)
+            if res is None:
+                continue
+            sig = proc.process_response(res, node)
+            if sig is not None:
+                break
+        if sig is not None:
+            return sig, tbs
+    return proc.sig, tbs
+
+
+def test_rsa_threshold_full_quorum():
+    key = rsakeys.generate(1024)
+    sig, tbs = sim_rsa_sign(key, 5, 3, set(range(5)))
+    assert sig is not None
+    assert rsakeys.verify_host(tbs, sig, key.public)
+    # matches the host signer exactly (deterministic PKCS#1 v1.5)
+    assert sig == rsakeys.sign(tbs, key)
+
+
+def test_rsa_threshold_k_subsets():
+    key = rsakeys.generate(1024)
+    n, k = 5, 3
+    subsets = [set(s) for s in [(0, 1, 2), (2, 3, 4), (0, 2, 4), (1, 3, 4)]]
+    for subset in subsets:
+        sig, tbs = sim_rsa_sign(key, n, k, subset)
+        assert sig is not None, f"subset {subset} failed"
+        assert rsakeys.verify_host(tbs, sig, key.public), subset
+
+
+def test_rsa_threshold_k_minus_one_insufficient():
+    key = rsakeys.generate(1024)
+    sig, _ = sim_rsa_sign(key, 5, 3, {0, 1})
+    assert sig is None
+
+
+def test_emsa_matches_host_encoding():
+    key = rsakeys.generate(1024)
+    prefix = trsa._HASH_PREFIXES["sha256"]
+    import hashlib
+
+    tbs = b"encode me"
+    m = trsa.emsa_encode(prefix, hashlib.sha256(tbs).digest(), key.size_bytes)
+    assert m == rsakeys.emsa_pkcs1v15_sha256(tbs, key.size_bytes)
+
+
+# -- DSA/ECDSA 3-phase simulation (reference: dsa_test.go:221-463) ---------
+
+
+def make_universe(n):
+    """n server identities with full cross-knowledge (tier-2 fake
+    backend: direct calls, no transport)."""
+    idents = []
+    for i in range(n):
+        key = rsakeys.generate(1024)
+        c = certmod.Certificate(n=key.n, name=f"s{i}", address=f"addr{i}", uid=f"u{i}")
+        certmod.sign_certificate(c, key)
+        idents.append((key, c))
+    bundles = []
+    for key, c in idents:
+        crypt = new_crypto(key, c)
+        for _, other in idents:
+            crypt.keyring.register([other])
+        bundles.append(crypt)
+    return idents, bundles
+
+
+def sim_dsa_sign(make_ctx, key, n, kthresh, tbs=b"dsa sign me", subset=None):
+    idents, bundles = make_universe(n)
+    nodes = [c for _, c in idents]
+    servers = {c.id: make_ctx(bundles[i]) for i, (_, c) in enumerate(idents)}
+    shares = {}
+    client_ctx = make_ctx(bundles[0])  # client reuses server-0 identity
+    out, algo = client_ctx.distribute(key, nodes, kthresh)
+    for node, share in zip(nodes, out):
+        shares[node.id] = share
+    client_id = 0xBEEF
+    proc = client_ctx.new_process(tbs, algo, "sha256")
+    for _round in range(10):
+        target, req = proc.make_request()
+        if not target:
+            break
+        result = None
+        advance = False
+        for node in target:
+            if subset is not None and node.id not in subset:
+                continue
+            res = servers[node.id].sign(shares[node.id], req, client_id, node.id)
+            if res is None:
+                continue
+            try:
+                result = proc.process_response(res, node)
+            except errors.ERR_CONTINUE:
+                advance = True
+                break
+            if result is not None:
+                return result
+        if result is not None:
+            return result
+        if not advance:
+            break
+    return None
+
+
+def test_dsa_threshold_roundtrip():
+    key = tdsa.generate(1024)
+    n = 6
+    sig = sim_dsa_sign(lambda crypt: tdsa.new(crypt), key, n, 3)
+    assert sig is not None
+    # standard DSA verify: v = (g^u1 · y^u2 mod p) mod q == r
+    size = (key.q.bit_length() + 7) // 8
+    r = int.from_bytes(sig[:size], "big")
+    s = int.from_bytes(sig[size:], "big")
+    assert 0 < r < key.q and 0 < s < key.q
+    import hashlib
+
+    ops = tdsa._DSAGroupOps(key.p, key.q, key.g)
+    m = ops.os2i(hashlib.sha256(b"dsa sign me").digest())
+    w = pow(s, -1, key.q)
+    u1 = (m * w) % key.q
+    u2 = (r * w) % key.q
+    v = (pow(key.g, u1, key.p) * pow(key.y, u2, key.p)) % key.p % key.q
+    assert v == r
+
+
+def test_ecdsa_threshold_roundtrip():
+    key = tecdsa.generate(ec.P256)
+    n = 6
+    tbs = b"ecdsa sign me"
+    sig = sim_dsa_sign(lambda crypt: tecdsa.new(crypt), key, n, 3, tbs=tbs)
+    assert sig is not None
+    size = 32
+    r = int.from_bytes(sig[:size], "big")
+    s = int.from_bytes(sig[size:], "big")
+    # cross-check against the host crypto library
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec as cec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        encode_dss_signature,
+    )
+
+    pub = key.curve.scalar_base_mult(key.d)
+    pubkey = cec.EllipticCurvePublicNumbers(
+        pub[0], pub[1], cec.SECP256R1()
+    ).public_key()
+    pubkey.verify(encode_dss_signature(r, s), tbs, cec.ECDSA(hashes.SHA256()))
+
+
+def test_dispatcher_routes_by_key_and_algo():
+    idents, bundles = make_universe(3)
+    nodes = [c for _, c in idents]
+    inst = ThresholdInstance(bundles[0])
+    key = rsakeys.generate(1024)
+    shares, algo = inst.distribute(key, nodes, 2)
+    assert algo == ThresholdAlgo.RSA
+    aux = serialize_params(algo, shares[0])
+    back_algo, data = parse_params(aux)
+    assert back_algo == ThresholdAlgo.RSA and data == shares[0]
+    with pytest.raises(errors.ERR_UNSUPPORTED_ALGORITHM):
+        inst.distribute(object(), nodes, 2)
+    with pytest.raises(errors.ERR_UNSUPPORTED_ALGORITHM):
+        parse_params(b"")
+
+
+def test_partial_param_hostile_bytes():
+    for data in [b"", b"\x00", b"\xff" * 7, secrets.token_bytes(40)]:
+        with pytest.raises(errors.Error):
+            trsa._parse_partial_param(data)
+        with pytest.raises(errors.Error):
+            trsa._parse_sign_request(data)
+        with pytest.raises(errors.Error):
+            trsa._parse_partial_signature(data)
