@@ -1,0 +1,45 @@
+"""Force JAX onto a virtual multi-device CPU mesh, robustly.
+
+Test and dry-run lanes need N virtual CPU devices
+(``--xla_force_host_platform_device_count``) regardless of what
+accelerator plugins the ambient environment pre-registered.  Some
+environments import jax at interpreter start (via ``sitecustomize``)
+with an accelerator platform pre-selected, so merely setting
+``JAX_PLATFORMS=cpu`` in the environment is too late: the config was
+captured at import.  :func:`force_cpu` repairs this in-process:
+
+- ensures ``XLA_FLAGS`` requests the virtual device count (honored as
+  long as the CPU client has not been instantiated yet);
+- drops any non-CPU PJRT backend factories so lazy backend discovery
+  cannot block on accelerator initialization;
+- updates ``jax.config`` (which wins over the captured env var).
+
+Call it before the first ``jax.devices()`` / trace.  Safe to call when
+jax has not been imported at all, and idempotent.
+"""
+
+from __future__ import annotations
+
+import os
+
+__all__ = ["force_cpu"]
+
+
+def force_cpu(n_devices: int = 8) -> None:
+    flags = [
+        f
+        for f in os.environ.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    ]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    os.environ["XLA_FLAGS"] = " ".join(flags)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax  # deferred: may or may not already be imported
+    import jax._src.xla_bridge as xb
+
+    factories = getattr(xb, "_backend_factories", None)
+    if isinstance(factories, dict):
+        for name in [k for k in factories if k != "cpu"]:
+            factories.pop(name, None)
+    jax.config.update("jax_platforms", "cpu")

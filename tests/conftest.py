@@ -3,14 +3,17 @@
 Real TPU hardware in CI has a single chip; all sharding tests use
 ``--xla_force_host_platform_device_count=8`` so multi-chip layouts
 compile and execute without real chips.
+
+The ambient environment may pre-import jax with an accelerator
+platform selected (sitecustomize PJRT plugin registration), so env
+vars alone are not enough — :mod:`bftkv_tpu.hostcpu` repairs the
+already-imported jax in-process.  An explicit TPU lane can opt out
+with ``BFTKV_TPU_LANE=1``.
 """
 
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+if os.environ.get("BFTKV_TPU_LANE") != "1":
+    from bftkv_tpu.hostcpu import force_cpu
+
+    force_cpu(8)
