@@ -127,6 +127,7 @@ ERR_INVALID_AUTHENTICATION_DATA = new_error("invalid authentication data")
 ERR_TOO_MANY_ATTEMPTS = new_error("too many authentication attempts")
 ERR_UNSUPPORTED_ALGORITHM = new_error("unsupported algorithm")
 ERR_SHARE_NOT_FOUND = new_error("share not found")
+ERR_INSUFFICIENT_NUMBER_OF_SECRETS = new_error("insufficient number of secrets")
 ERR_CONTINUE = new_error("continue")  # threshold phase loop sentinel
 ERR_DECRYPTION_FAILURE = new_error("decryption failure")
 

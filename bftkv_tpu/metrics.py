@@ -1,0 +1,81 @@
+"""Process-wide metrics registry: counters, gauges, latency histograms.
+
+The reference has no metrics beyond ``log.Printf`` (SURVEY.md §5); the
+TPU framework needs them to steer batching — sig-verifies/sec, device
+batch occupancy, quorum latencies are the signals the dispatcher and
+the benchmark harness read.  Deliberately dependency-free and cheap:
+one lock, plain dicts, snapshot on demand.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict
+
+__all__ = ["Metrics", "registry"]
+
+
+class Metrics:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = defaultdict(int)
+        self._sums: dict[str, float] = defaultdict(float)
+        self._samples: dict[str, list[float]] = defaultdict(list)
+        self._max_samples = 65536
+
+    def incr(self, name: str, n: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += n
+
+    def observe(self, name: str, value: float) -> None:
+        """Record one sample (latency seconds, batch size, ...)."""
+        with self._lock:
+            self._counters[name + ".count"] += 1
+            self._sums[name + ".sum"] += value
+            s = self._samples[name]
+            if len(s) < self._max_samples:
+                s.append(value)
+
+    class _Timer:
+        def __init__(self, m: "Metrics", name: str):
+            self.m, self.name = m, name
+
+        def __enter__(self):
+            self.t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self.m.observe(self.name, time.perf_counter() - self.t0)
+            return False
+
+    def timer(self, name: str) -> "Metrics._Timer":
+        return Metrics._Timer(self, name)
+
+    def percentile(self, name: str, q: float) -> float | None:
+        with self._lock:
+            s = sorted(self._samples.get(name, ()))
+        if not s:
+            return None
+        i = min(len(s) - 1, int(q * len(s)))
+        return s[i]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out: dict = dict(self._counters)
+            out.update(self._sums)
+        for name in list(self._samples):
+            for q, tag in ((0.5, "p50"), (0.99, "p99")):
+                v = self.percentile(name, q)
+                if v is not None:
+                    out[f"{name}.{tag}"] = v
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+            self._sums.clear()
+            self._samples.clear()
+
+
+registry = Metrics()

@@ -1,0 +1,107 @@
+"""Protocol layer: the replicated-KV state machines.
+
+Capability parity with the reference's ``protocol`` package:
+- :class:`Protocol` — shared state (self node, quorum system, transport,
+  crypto, threshold) + membership gossip (reference:
+  protocol/protocol.go:13-60);
+- :class:`bftkv_tpu.protocol.client.Client` — three-phase signed write,
+  quorum read with read-repair and revoke-on-read, TPA driver,
+  threshold-signing driver (reference: protocol/client.go:52-546);
+- :class:`bftkv_tpu.protocol.server.Server` — the 13 command handlers
+  behind decrypt→dispatch→encrypt (reference: protocol/server.go:33-620).
+
+TPU stance: the protocol layer is control flow — pure Python, no
+tensors.  All hot crypto (signature verify/sign, modexp, tallies) is
+delegated downward to ``bftkv_tpu.crypto`` / ``bftkv_tpu.ops`` where it
+runs as batched device kernels; the server additionally funnels verify
+work through the cross-request batching dispatcher
+(``bftkv_tpu.ops.dispatch``) so concurrent handlers share kernel
+launches.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from bftkv_tpu import transport as tp
+from bftkv_tpu.crypto import cert as certmod
+from bftkv_tpu.crypto.threshold import ThresholdInstance
+
+__all__ = ["Protocol", "majority_error", "MAX_UINT64", "Ref"]
+
+MAX_UINT64 = 2**64 - 1
+
+
+class Ref:
+    """Minimal node stand-in for revoking an id we have no cert for."""
+
+    __slots__ = ("id",)
+
+    def __init__(self, nid: int):
+        self.id = nid
+
+
+def majority_error(errs: list, fallback):
+    """The most common error in a fan-out, or ``fallback`` when none
+    (reference: protocol/client.go:28-50)."""
+    if not errs:
+        return fallback
+    counts = Counter(str(e) for e in errs)
+    winner = counts.most_common(1)[0][0]
+    for e in errs:
+        if str(e) == winner:
+            return e
+    return fallback
+
+
+class Protocol:
+    """Shared protocol state (reference: protocol/protocol.go:13-19).
+
+    ``self_node`` is the trust :class:`bftkv_tpu.graph.Graph` doubling
+    as the node identity, exactly as the reference's ``Graph``
+    implements ``SelfNode``.
+    """
+
+    def __init__(self, self_node, qs, tr, crypt):
+        self.self_node = self_node
+        self.qs = qs
+        self.tr = tr
+        self.crypt = crypt
+        self.threshold = ThresholdInstance(crypt)
+
+    def joining(self) -> None:
+        """Iterative gossip crawl: multicast Join to every not-yet-asked
+        peer, fold returned certificates into the graph + keyring,
+        repeat until no new peers appear (reference:
+        protocol/protocol.go:21-52)."""
+        asked: set[int] = set()
+        pkt = self.self_node.serialize_self()
+        while True:
+            peers = [
+                n for n in self.self_node.get_peers() if n.id not in asked
+            ]
+            if not peers:
+                break
+            asked.update(n.id for n in peers)
+
+            def cb(res: tp.MulticastResponse) -> bool:
+                # Errors are ignored: the peer may simply not know our
+                # certificate yet (reference: protocol.go:39-41).
+                if res.data:
+                    try:
+                        nodes = certmod.parse(res.data)
+                    except Exception:
+                        return False
+                    added = self.self_node.add_peers(nodes)
+                    try:
+                        self.crypt.keyring.register(added)
+                    except Exception:
+                        self.self_node.remove_peers(added)
+                return False  # go through all nodes
+
+            self.tr.multicast(tp.JOIN, peers, pkt, cb)
+
+    def leaving(self) -> None:
+        """Broadcast our departure (reference: protocol/protocol.go:54-60)."""
+        pkt = self.self_node.serialize_self()
+        self.tr.multicast(tp.LEAVE, self.self_node.get_peers(), pkt, None)

@@ -1,0 +1,471 @@
+"""Protocol client: the replicated-KV state machine, client side.
+
+Capability parity with the reference (protocol/client.go:52-546):
+- ``write``: Time → Sign → Write three phases (client.go:62-123);
+- ``collect_signatures``: self-sign TBS, accumulate a collective
+  signature over the AUTH|PEER quorum (client.go:125-170);
+- ``read``: fan-out with responses bucketed by ``(t, value)``, early
+  return through a result queue once a bucket reaches threshold at the
+  max timestamp, then read-repair (``write_back``) and revoke-on-read
+  of equivocating signers (client.go:189-353);
+- TPA driver (client.go:359-474) and threshold-signing driver
+  (client.go:480-546) with the ``ERR_CONTINUE`` phase loop.
+
+Every callback runs on the multicast fan-in thread (one per request),
+so per-operation state needs no locks — same discipline as the
+reference's closure-over-locals pattern.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from bftkv_tpu import packet as pkt
+from bftkv_tpu import quorum as qm
+from bftkv_tpu import transport as tp
+from bftkv_tpu.crypto import auth as authmod
+from bftkv_tpu.crypto import signature as sigmod
+from bftkv_tpu.crypto.threshold import ThresholdAlgo, serialize_params
+from bftkv_tpu.errors import (
+    ERR_CONTINUE,
+    ERR_INSUFFICIENT_NUMBER_OF_QUORUM,
+    ERR_INSUFFICIENT_NUMBER_OF_RESPONSES,
+    ERR_INSUFFICIENT_NUMBER_OF_SECRETS,
+    ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES,
+    ERR_INSUFFICIENT_NUMBER_OF_VALID_RESPONSES,
+    ERR_INVALID_TIMESTAMP,
+    ERR_NO_AUTHENTICATION_DATA,
+)
+from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu.protocol import MAX_UINT64, Protocol, Ref, majority_error
+
+__all__ = ["Client", "MAX_UINT64"]
+
+
+class _SignedValue:
+    """One read response: (node, sig, ss, raw packet)
+    (reference: client.go:172-177)."""
+
+    __slots__ = ("node", "sig", "ss", "packet")
+
+    def __init__(self, node, sig, ss, packet):
+        self.node = node
+        self.sig = sig
+        self.ss = ss
+        self.packet = packet
+
+
+class _InProgress(Exception):
+    """Internal sentinel: no bucket has reached threshold yet
+    (reference: errInProgress, client.go:179)."""
+
+
+class Client(Protocol):
+    # -- write path (reference: client.go:62-170) -------------------------
+
+    def write(self, variable: bytes, value: bytes, proof=None) -> None:
+        """Three-phase signed write: collect timestamps from a READ|AUTH
+        quorum, then sign + store (reference: client.go:62-92)."""
+        with metrics.timer("client.write.latency"):
+            qr = self.qs.choose_quorum(qm.READ | qm.AUTH)
+            maxt = 0
+            actives: list = []
+            failure: list = []
+
+            def cb(res: tp.MulticastResponse) -> bool:
+                nonlocal maxt
+                if res.err is None and res.data and len(res.data) <= 8:
+                    t = int.from_bytes(res.data, "big")
+                    if t > maxt:
+                        maxt = t
+                    actives.append(res.peer)
+                    return qr.is_threshold(actives)
+                failure.append(res.peer)
+                return qr.reject(failure)
+
+            self.tr.multicast(tp.TIME, qr.nodes(), variable, cb)
+            if not qr.is_threshold(actives):
+                raise ERR_INSUFFICIENT_NUMBER_OF_QUORUM
+            if maxt == MAX_UINT64:
+                raise ERR_INVALID_TIMESTAMP
+            self._write_with_timestamp(variable, value, maxt + 1, proof)
+            metrics.incr("client.write.ok")
+
+    def write_once(self, variable: bytes, value: bytes, proof=None) -> None:
+        """t = 2^64-1 marks the value immutable forever
+        (reference: client.go:90-92)."""
+        self._write_with_timestamp(variable, value, MAX_UINT64, proof)
+
+    def _write_with_timestamp(
+        self, variable: bytes, value: bytes, t: int, proof
+    ) -> None:
+        sig, ss = self.collect_signatures(variable, value, t, proof)
+
+        qw = self.qs.choose_quorum(qm.WRITE)
+        data = pkt.serialize(variable, value, t, sig, ss)
+        nodes: list = []
+        failure: list = []
+        errs: list = []
+
+        def cb(res: tp.MulticastResponse) -> bool:
+            if res.err is None:
+                nodes.append(res.peer)
+                return qw.is_threshold(nodes)
+            failure.append(res.peer)
+            errs.append(res.err)
+            return qw.reject(failure)
+
+        self.tr.multicast(tp.WRITE, qw.nodes(), data, cb)
+        if not qw.is_threshold(nodes):
+            raise majority_error(errs, ERR_INSUFFICIENT_NUMBER_OF_RESPONSES)
+
+    def collect_signatures(
+        self, variable: bytes, value: bytes, t: int, proof
+    ):
+        """Self-sign <x,v,t>, then accumulate quorum members' signature
+        shares into a collective signature until sufficient
+        (reference: client.go:125-170).  Returns ``(sig, ss)``."""
+        tbs = pkt.serialize(variable, value, t, nfields=3)
+        sig = self.crypt.signer.issue(tbs)
+        tbss = pkt.serialize(variable, value, t, sig, nfields=4)
+
+        qa = self.qs.choose_quorum(qm.AUTH | qm.PEER)
+        # The client's auth proof rides in the ss slot of the request
+        # (reference: client.go:142).
+        req = pkt.serialize(variable, value, t, sig, proof)
+        ss = None
+        failure: list = []
+        errs: list = []
+
+        def cb(res: tp.MulticastResponse) -> bool:
+            nonlocal ss
+            err = res.err
+            if err is None and res.data is not None:
+                try:
+                    share = pkt.parse_signature(res.data)
+                    ss, done = self.crypt.collective.combine(
+                        ss, share, qa, self.crypt.keyring
+                    )
+                    return done
+                except Exception as e:
+                    err = e
+            if err is None:
+                return False
+            errs.append(err)
+            failure.append(res.peer)
+            return qa.reject(failure)
+
+        self.tr.multicast(tp.SIGN, qa.nodes(), req, cb)
+        try:
+            self.crypt.collective.verify(tbss, ss, qa, self.crypt.keyring)
+        except Exception as e:
+            raise majority_error(errs, e)
+        return sig, ss
+
+    # -- read path (reference: client.go:189-353) -------------------------
+
+    def read(self, variable: bytes, proof=None) -> bytes | None:
+        """Quorum read.  Returns as soon as some value reaches threshold
+        at the maximum timestamp; the fan-out keeps running on a worker
+        thread to finish revoke-on-read and read-repair
+        (reference: client.go:237-279)."""
+        with metrics.timer("client.read.latency"):
+            q = self.qs.choose_quorum(qm.READ)
+            req = pkt.serialize(variable, None, 0, None, proof)
+            ch: "queue.Queue[tuple[bytes | None, Exception | None]]" = (
+                queue.Queue(maxsize=1)
+            )
+
+            worker = threading.Thread(
+                target=self._read_worker,
+                args=(q, req, ch),
+                daemon=True,
+            )
+            worker.start()
+            value, err = ch.get()
+            if err is not None:
+                raise err
+            return value
+
+    def _read_worker(self, q, req: bytes, ch) -> None:
+        m: dict[int, dict[bytes, list[_SignedValue]]] = {}
+        done = False
+        value = None
+        maxt = 0
+        failure: list = []
+        errs: list = []
+
+        def deliver(val, err) -> None:
+            nonlocal done
+            if not done:
+                done = True
+                ch.put((val, err))
+
+        def cb(res: tp.MulticastResponse) -> bool:
+            nonlocal value, maxt
+            err = self._process_response(res, m)
+            if err is None:
+                if not done:
+                    try:
+                        value, maxt = self._max_timestamped_value(m, q)
+                        deliver(value, None)
+                    except _InProgress:
+                        pass
+                    except Exception as e:
+                        deliver(None, e)
+            else:
+                failure.append(res.peer)
+                errs.append(err)
+                if not done and q.reject(failure):
+                    deliver(
+                        None,
+                        majority_error(
+                            errs, ERR_INSUFFICIENT_NUMBER_OF_VALID_RESPONSES
+                        ),
+                    )
+            return False  # go through all members of the quorum
+
+        self.tr.multicast(tp.READ, q.nodes(), req, cb)
+        deliver(None, ERR_INSUFFICIENT_NUMBER_OF_RESPONSES)
+        self._revoke_on_read(m)
+        if value:
+            self._write_back(q.nodes(), m, value, maxt)
+
+    @staticmethod
+    def _process_response(res: tp.MulticastResponse, m) -> Exception | None:
+        """Bucket one response by (t, value) (reference: client.go:207-230)."""
+        if res.err is not None:
+            return res.err
+        val = None
+        sig = ss = None
+        t = 0
+        raw = res.data
+        if raw:
+            try:
+                p = pkt.parse(raw)
+            except Exception as e:
+                return e
+            val, t, sig, ss = p.value, p.t, p.sig, p.ss
+        vl = m.setdefault(t, {})
+        vl.setdefault(val or b"", []).append(
+            _SignedValue(res.peer, sig, ss, raw)
+        )
+        return None
+
+    @staticmethod
+    def _max_timestamped_value(m, q) -> tuple[bytes | None, int]:
+        """First value at the max timestamp whose responder set reaches
+        threshold (reference: client.go:189-205)."""
+        if not m:
+            raise _InProgress
+        maxt = max(m)
+        for val, svl in m[maxt].items():
+            if q.is_threshold([sv.node for sv in svl]):
+                return (val or None), maxt
+        raise _InProgress
+
+    def _write_back(self, universe, m, value: bytes, t: int) -> None:
+        """Read-repair: push the winning packet to every node that did
+        not respond with it (reference: client.go:281-302)."""
+        have = {sv.node.id for sv in m.get(t, {}).get(value, ())}
+        stale = [n for n in universe if n.id not in have]
+        if not stale:
+            return
+        bucket = m.get(t, {}).get(value)
+        if not bucket:
+            return
+        metrics.incr("client.read.repair", len(stale))
+        self.tr.multicast(tp.WRITE, stale, bucket[0].packet, None)
+
+    def _revoke_on_read(self, m) -> None:
+        """Signers that signed two different values at the same
+        timestamp get revoked; the revocation list is broadcast
+        (reference: client.go:304-353)."""
+        revoked: set[int] = set()
+        for t, vl in m.items():
+            if t == 0:
+                continue
+            seen: dict[int, int] = {}  # signer id -> bucket round
+            for round_no, svl in enumerate(vl.values()):
+                for sv in svl:
+                    for sid in sigmod.signers(sv.ss):
+                        prev = seen.get(sid)
+                        if prev is None:
+                            seen[sid] = round_no
+                        elif prev != round_no and sid not in revoked:
+                            self._do_revoke(sid)
+                            revoked.add(sid)
+        if revoked:
+            rl = self.self_node.serialize_revoked()
+            if rl:
+                self.tr.multicast(
+                    tp.NOTIFY, self.self_node.get_peers(), rl, None
+                )
+
+    def _do_revoke(self, sid: int) -> None:
+        node = self.crypt.keyring.get(sid)
+        if node is None:
+            node = Ref(sid)
+        self.self_node.revoke(node)
+        metrics.incr("client.revocations")
+
+    # -- TPA driver (reference: client.go:359-474) ------------------------
+
+    def authenticate(self, variable: bytes, cred: bytes):
+        """Threshold password authentication.  Returns ``(proof, key)``:
+        the collective-signature proof and the symmetric cipher key
+        (reference: client.go:359-377)."""
+        q = self.qs.choose_quorum(qm.AUTH | qm.PEER)
+        aclient = authmod.AuthClient(cred, len(q.nodes()), q.get_threshold())
+        try:
+            proof = self._do_authentication(aclient, variable, q)
+        except ERR_NO_AUTHENTICATION_DATA:
+            # Virgin variable: distribute fresh auth params, then retry.
+            self._setup_auth_params(variable, cred, q)
+            proof = self._do_authentication(aclient, variable, q)
+        key = aclient.get_cipher_key()
+        return proof, key
+
+    def _do_authentication(self, aclient, variable: bytes, q):
+        nodes = q.nodes()
+        pdata = aclient.initiate([n.id for n in nodes])
+        phase = 0
+        while not aclient.done(phase):
+            mpkt = [
+                pkt.serialize_auth_request(phase, variable, pdata[n.id])
+                if n.id in pdata
+                else None
+                for n in nodes
+            ]
+            succ: list = []
+            failure: list = []
+            errs: list = []
+            nextp = None
+
+            def cb(res: tp.MulticastResponse) -> bool:
+                nonlocal nextp
+                err = res.err
+                if err is None:
+                    try:
+                        out = aclient.process_response(
+                            phase, res.data or b"", res.peer.id
+                        )
+                        succ.append(res.peer)
+                        if out is not None:
+                            nextp = out
+                            return True
+                        return False
+                    except Exception as e:
+                        err = e
+                errs.append(err)
+                failure.append(res.peer)
+                return q.reject(failure)
+
+            self.tr.multicast_m(tp.AUTH, nodes, mpkt, cb)
+            if nextp is None:
+                raise majority_error(errs, ERR_INSUFFICIENT_NUMBER_OF_SECRETS)
+            pdata = nextp
+            nodes = succ
+            phase += 1
+
+        # pdata now maps node id -> its released signature share.
+        ss = None
+        suff = False
+        for data in pdata.values():
+            try:
+                share = pkt.parse_signature(data)
+            except Exception:
+                continue
+            if share is None:
+                continue
+            ss, suff = self.crypt.collective.combine(
+                ss, share, q, self.crypt.keyring
+            )
+        if not suff:
+            raise ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES
+        return ss
+
+    def _setup_auth_params(self, variable: bytes, cred: bytes, q) -> None:
+        """Shamir-share a fresh secret across the quorum
+        (reference: client.go:439-474)."""
+        tbs = pkt.serialize(variable, None, 0, nfields=3)
+        sig = self.crypt.signer.issue(tbs)
+        params = authmod.generate_partial_auth_params(
+            cred, len(q.nodes()), q.get_threshold()
+        )
+        mpkt = [
+            pkt.serialize(variable, None, 0, sig, None, p) for p in params
+        ]
+        succ: list = []
+
+        def cb(res: tp.MulticastResponse) -> bool:
+            if res.err is None:
+                succ.append(res.peer)
+            return False  # broadcast to as many as possible
+
+        self.tr.multicast_m(tp.SETAUTH, q.nodes(), mpkt, cb)
+        if not q.is_sufficient(succ):
+            raise ERR_INSUFFICIENT_NUMBER_OF_VALID_RESPONSES
+
+    # -- distributed crypto (reference: client.go:480-546) ----------------
+
+    def distribute(self, caname: str, key) -> None:
+        """Deal threshold shares of ``key`` to an AUTH quorum
+        (reference: client.go:480-507)."""
+        q = self.qs.choose_quorum(qm.AUTH)
+        k = q.get_threshold()
+        secrets, algo = self.threshold.distribute(key, q.nodes(), k)
+        mpkt = [
+            pkt.serialize(caname.encode(), serialize_params(algo, s), nfields=2)
+            for s in secrets
+        ]
+        succ = 0
+
+        def cb(res: tp.MulticastResponse) -> bool:
+            nonlocal succ
+            if res.err is None:
+                succ += 1
+            return False
+
+        self.tr.multicast_m(tp.DISTRIBUTE, q.nodes(), mpkt, cb)
+        if succ < k:
+            raise ERR_INSUFFICIENT_NUMBER_OF_RESPONSES
+
+    def dist_sign(
+        self, caname: str, tbs: bytes, algo: ThresholdAlgo, hash_name: str
+    ) -> bytes:
+        """Threshold-sign ``tbs`` with the CA key dealt under ``caname``;
+        loops phases until the signature completes
+        (reference: client.go:509-546)."""
+        proc = self.threshold.new_process(tbs, algo, hash_name)
+        while True:
+            nodes, req = proc.make_request()
+            if not nodes:
+                raise ERR_INSUFFICIENT_NUMBER_OF_RESPONSES
+            data = pkt.serialize(caname.encode(), req, nfields=2)
+            sig_out = None
+            err_out: Exception | None = None
+            succ = 0
+
+            def cb(res: tp.MulticastResponse) -> bool:
+                nonlocal sig_out, err_out, succ
+                if res.err is None and res.data is not None:
+                    succ += 1
+                    try:
+                        sig_out = proc.process_response(res.data, res.peer)
+                    except Exception as e:
+                        err_out = e
+                        return True
+                    return sig_out is not None
+                return False
+
+            self.tr.multicast(tp.DISTSIGN, nodes, data, cb)
+            if isinstance(err_out, ERR_CONTINUE):
+                continue
+            if err_out is not None:
+                raise err_out
+            if sig_out is not None:
+                return sig_out
+            if succ == 0:  # no more new responses
+                raise ERR_INSUFFICIENT_NUMBER_OF_RESPONSES

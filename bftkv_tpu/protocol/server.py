@@ -1,0 +1,511 @@
+"""Protocol server: the 13 command handlers behind decrypt→dispatch→encrypt.
+
+Capability parity with the reference (protocol/server.go:33-620):
+- ``sign`` — the guts of the write path: verify the writer's signature
+  with its own certificate, require the writer's certificate to be
+  signed by a CERT-quorum threshold (the *quorum certificate*,
+  server.go:211-214), the equivocation check "never sign <x,t,v≠v'>"
+  with revocation of double-signers (server.go:242-256), and persist
+  the request *without* ss to mark the write in-progress
+  (server.go:275-281);
+- ``write`` — collective-signature sufficiency, timestamp /
+  equivocation / TOFU checks (TOFU: a new issuer must match the
+  previous issuer's id **or** uid, server.go:329-337);
+- ``read`` — latest *completed* version (scan back past sign-only
+  entries), TPA proof enforcement on protected variables
+  (server.go:145-187);
+- TPA session map per protected variable (server.go:375-448),
+  ``register`` (decentralized enrollment, server.go:450-514),
+  ``distribute``/``dist_sign`` with the ``!!!secret!!!`` hidden prefix
+  (server.go:31,516-541), join/leave/revoke/notify maintenance.
+
+TPU stance: handlers are control flow; every signature verification
+goes through ``crypt.collective`` / ``verify_with_certificate`` whose
+modexp batches run on device, and the server-side entry points are
+instrumented so the batching dispatcher can coalesce concurrent
+requests.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from bftkv_tpu import packet as pkt
+from bftkv_tpu import quorum as qm
+from bftkv_tpu import storage as st
+from bftkv_tpu import transport as tp
+from bftkv_tpu.crypto import auth as authmod
+from bftkv_tpu.crypto import cert as certmod
+from bftkv_tpu.crypto import signature as sigmod
+from bftkv_tpu.errors import (
+    ERR_AUTHENTICATION_FAILURE,
+    ERR_BAD_TIMESTAMP,
+    ERR_EQUIVOCATION,
+    ERR_EXIST,
+    ERR_INVALID_QUORUM_CERTIFICATE,
+    ERR_INVALID_SIGN_REQUEST,
+    ERR_INVALID_USER_ID,
+    ERR_MALFORMED_REQUEST,
+    ERR_NO_AUTHENTICATION_DATA,
+    ERR_NO_MORE_WRITE,
+    ERR_NOT_FOUND,
+    ERR_PERMISSION_DENIED,
+    ERR_TOO_MANY_ATTEMPTS,
+    ERR_UNKNOWN_COMMAND,
+)
+from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu.protocol import MAX_UINT64, Protocol, Ref
+
+__all__ = ["Server", "HIDDEN_PREFIX", "MAX_UINT64"]
+
+log = logging.getLogger("bftkv_tpu.protocol.server")
+
+# Threshold shares are stored under variables no client request may
+# name directly (reference: server.go:31, time/read reject the prefix).
+HIDDEN_PREFIX = b"!!!secret!!!"
+
+
+class Server(Protocol):
+    def __init__(self, self_node, qs, tr, crypt, storage):
+        super().__init__(self_node, qs, tr, crypt)
+        self.storage = storage
+        self._auth: dict[bytes, authmod.AuthServer] = {}
+
+    # -- lifecycle (reference: server.go:47-62) ---------------------------
+
+    def start(self) -> None:
+        addr = self.self_node.address
+        if addr:
+            self.tr.start(self, _listen_addr(addr))
+            log.info("server @ %s running", addr)
+
+    def stop(self) -> None:
+        self.leaving()
+        self.tr.stop()
+
+    # -- dispatch (reference: server.go:562-620) --------------------------
+
+    def handler(self, cmd: int, data: bytes) -> bytes | None:
+        """decrypt → dispatch → encrypt.  Errors raise; the transport
+        layer tunnels them back (x-error header / loopback raise)."""
+        plain, sender, nonce = self.crypt.message.decrypt(data)
+        # "peer" is the sender as *we* know it — None on first contact
+        # (the reference's nil peer, server.go:566-569).
+        peer = self.crypt.keyring.get(sender.id)
+
+        h = self._handlers.get(cmd)
+        if h is None:
+            raise ERR_UNKNOWN_COMMAND
+        metrics.incr(f"server.{tp.COMMAND_NAMES.get(cmd, cmd)}.count")
+        res = h(self, plain, peer, sender)
+        return self.crypt.message.encrypt([sender], res or b"", nonce)
+
+    # -- membership (reference: server.go:64-120) -------------------------
+
+    def _join(self, req: bytes, peer, sender) -> bytes | None:
+        if peer is not None and peer.id == self.self_node.id:
+            log.info("server [%s]: joining to itself?", peer.name)
+            return None
+        nodes = certmod.parse(req)
+        certs: list = []
+        if peer is not None:
+            # Accept only the peer's own certificate.
+            certs = [n for n in nodes if n.id == peer.id]
+        elif nodes:
+            # First contact: trust the first certificate.
+            if nodes[0].id == self.self_node.id:
+                log.info("server [%s]: joining to itself?", nodes[0].name)
+                return None
+            certs = [nodes[0]]
+        certs = self.self_node.add_peers(certs)
+        try:
+            self.crypt.keyring.register(certs)
+        except Exception:
+            self.self_node.remove_peers(certs)  # stay consistent
+            raise
+        # Reply with our whole view so the joiner can crawl the graph.
+        return self.self_node.serialize_nodes()
+
+    def _leave(self, req: bytes, peer, sender) -> bytes | None:
+        nodes = certmod.parse(req)
+        for n in nodes:
+            if peer is not None and n.id == peer.id:
+                self.self_node.remove_peers([n])
+                # the key stays in the keyring (reference: server.go:115)
+        return None
+
+    # -- timestamps (reference: server.go:122-143) ------------------------
+
+    def _time(self, req: bytes, peer, sender) -> bytes:
+        variable = req
+        if variable.startswith(HIDDEN_PREFIX):
+            raise ERR_PERMISSION_DENIED
+        t = 0
+        try:
+            raw = self.storage.read(variable, 0)
+            t = pkt.parse(raw).t
+        except ERR_NOT_FOUND:
+            pass
+        return t.to_bytes(8, "big")
+
+    # -- read (reference: server.go:145-187) ------------------------------
+
+    def _read(self, req: bytes, peer, sender) -> bytes | None:
+        p = pkt.parse(req)
+        variable = p.variable or b""
+        proof = p.ss  # the client's TPA proof rides in the ss slot
+        if variable.startswith(HIDDEN_PREFIX):
+            raise ERR_PERMISSION_DENIED
+        raw = None
+        authenticated = None
+        try:
+            raw = self.storage.read(variable, 0)
+        except ERR_NOT_FOUND:
+            raw = None
+        if raw is not None:
+            stored = pkt.parse(raw)
+            authenticated = stored.auth
+            if stored.ss is None or not stored.ss.completed:
+                # A sign request arrived but the write never completed —
+                # scan back for the last completed version
+                # (reference: server.go:166-180).
+                raw = None
+                for t in self._versions_below(variable, stored.t):
+                    try:
+                        candidate = self.storage.read(variable, t)
+                    except ERR_NOT_FOUND:
+                        continue
+                    cp = pkt.parse(candidate)
+                    if cp.ss is not None and cp.ss.completed:
+                        raw = candidate
+                        break
+        if authenticated is not None:
+            if proof is None:
+                raise ERR_AUTHENTICATION_FAILURE
+            try:
+                self.crypt.collective.verify(
+                    variable,
+                    proof,
+                    self.qs.choose_quorum(qm.AUTH),
+                    self.crypt.keyring,
+                )
+            except Exception:
+                raise ERR_AUTHENTICATION_FAILURE from None
+        return raw
+
+    def _versions_below(self, variable: bytes, t: int):
+        """Stored version timestamps < ``t``, descending.  Prefers the
+        backend's version listing; falls back to a bounded countdown
+        (an incomplete write-once at 2^64-1 must not spin forever)."""
+        versions = getattr(self.storage, "versions", None)
+        if versions is not None:
+            try:
+                return sorted(
+                    (v for v in versions(variable) if v < t), reverse=True
+                )
+            except Exception:
+                pass
+        return range(t - 1, max(0, t - 1024), -1)
+
+    # -- sign (reference: server.go:189-284) ------------------------------
+
+    def _sign(self, req: bytes, peer, sender) -> bytes:
+        p = pkt.parse(req)
+        variable, val, t, sig, ss = p.variable or b"", p.value, p.t, p.sig, p.ss
+        if sig is None:
+            raise ERR_MALFORMED_REQUEST
+        # Hardening beyond the reference (which guards only time/read,
+        # server.go:126,153): a client-visible sign/write of a
+        # hidden-prefix variable would shadow threshold-CA shares
+        # stored there by _distribute.
+        if variable.startswith(HIDDEN_PREFIX):
+            raise ERR_PERMISSION_DENIED
+
+        # Verify the writer's signature with its own certificate.
+        issuer = sigmod.issuer(sig, self.crypt.keyring)
+        tbs = pkt.tbs(req)
+        sigmod.verify_with_certificate(tbs, sig, issuer)
+
+        # Quorum certificate: the writer's certificate must be signed by
+        # a CERT-quorum threshold (reference: server.go:211-214).
+        q = self.qs.choose_quorum(qm.AUTH | qm.CERT)
+        signer_nodes = [
+            c
+            for sid in issuer.signers()
+            if (c := self.crypt.keyring.get(sid)) is not None
+        ]
+        if not q.is_threshold(signer_nodes):
+            raise ERR_INVALID_QUORUM_CERTIFICATE
+
+        rdata = None
+        try:
+            rdata = self.storage.read(variable, 0)
+        except ERR_NOT_FOUND:
+            pass
+
+        proof = None
+        if rdata is not None:
+            rp = pkt.parse(rdata)
+            # TPA check first (reference: server.go:232-241): ``ss`` in
+            # the sign request carries the client's auth proof.
+            if rp.auth is not None:
+                if ss is None:
+                    raise ERR_AUTHENTICATION_FAILURE
+                try:
+                    self.crypt.collective.verify(
+                        variable,
+                        ss,
+                        self.qs.choose_quorum(qm.AUTH),
+                        self.crypt.keyring,
+                    )
+                except Exception:
+                    raise ERR_AUTHENTICATION_FAILURE from None
+            # Never sign both <x,t,v> and <x,t,v'>
+            # (reference: server.go:242-262).
+            if rp.t == MAX_UINT64:
+                raise ERR_NO_MORE_WRITE
+            if t == rp.t and val != rp.value:
+                if self._revoke_signers(
+                    sigmod.signers(sig), sigmod.signers(rp.sig)
+                ):
+                    raise ERR_EQUIVOCATION
+                raise ERR_INVALID_SIGN_REQUEST  # someone beat me
+            if t < rp.t:
+                raise ERR_BAD_TIMESTAMP
+            proof = rp.auth  # inherit the auth params
+
+        tbss = pkt.tbss(req)
+        share = self.crypt.collective.sign(self.crypt.signer, tbss)
+        res = pkt.serialize_signature(share)
+
+        # Persist the request *without* ss — marks the write in-progress
+        # (reference: server.go:275-281).
+        stored = pkt.serialize(variable, val, t, sig, None, proof)
+        self.storage.write(variable, t, stored)
+        metrics.incr("server.sign.ok")
+        return res
+
+    # -- write (reference: server.go:286-352) -----------------------------
+
+    def _write(self, req: bytes, peer, sender) -> bytes | None:
+        p = pkt.parse(req)
+        variable, val, t, sig, ss = p.variable or b"", p.value, p.t, p.sig, p.ss
+        if sig is None or ss is None:
+            raise ERR_MALFORMED_REQUEST
+        if variable.startswith(HIDDEN_PREFIX):
+            raise ERR_PERMISSION_DENIED
+
+        # Sufficient quorum members must have signed the same <x,v,t>.
+        tbss = pkt.tbss(req)
+        self.crypt.collective.verify(
+            tbss, ss, self.qs.choose_quorum(qm.AUTH), self.crypt.keyring
+        )
+
+        rdata = None
+        try:
+            rdata = self.storage.read(variable, 0)
+        except ERR_NOT_FOUND:
+            pass
+
+        out = req
+        if rdata is not None:
+            rp = pkt.parse(rdata)
+            if rp.t == MAX_UINT64:
+                raise ERR_NO_MORE_WRITE
+            if t < rp.t:
+                raise ERR_BAD_TIMESTAMP
+            if t == rp.t and val != rp.value:
+                if rp.ss is not None:
+                    self._revoke_signers(
+                        sigmod.signers(ss), sigmod.signers(rp.ss)
+                    )
+                raise ERR_EQUIVOCATION
+
+            # TOFU: the new issuer must match the previous issuer's id
+            # or uid (reference: server.go:329-337).
+            new_issuer = sigmod.issuer(sig, self.crypt.keyring)
+            prev_issuer = sigmod.issuer(rp.sig, self.crypt.keyring)
+            if (
+                prev_issuer.id != new_issuer.id
+                and prev_issuer.uid != new_issuer.uid
+            ):
+                raise ERR_PERMISSION_DENIED
+
+            if rp.auth is not None:  # inherit auth params
+                out = pkt.serialize(variable, val, t, sig, ss, rp.auth)
+
+        self.storage.write(variable, t, out)
+        metrics.incr("server.write.ok")
+        return None
+
+    def _revoke_signers(self, signers1: list[int], signers2: list[int]) -> bool:
+        """Revoke every id present in both signer sets; broadcast the
+        revocation list when anyone fell (reference: server.go:354-373)."""
+        both = set(signers1) & set(signers2)
+        revoked = False
+        for sid in both:
+            node = self.crypt.keyring.get(sid)
+            if node is None:
+                node = Ref(sid)
+            self.self_node.revoke(node)
+            revoked = True
+            metrics.incr("server.revocations")
+        if revoked:
+            rl = self.self_node.serialize_revoked()
+            if rl:
+                self.tr.multicast(
+                    tp.NOTIFY, self.self_node.get_peers(), rl, None
+                )
+        return revoked
+
+    # -- TPA (reference: server.go:375-448) -------------------------------
+
+    def _set_auth(self, req: bytes, peer, sender) -> bytes | None:
+        p = pkt.parse(req)
+        variable = p.variable or b""
+        if p.sig is None or p.auth is None or p.t != 0:
+            raise ERR_MALFORMED_REQUEST
+        if variable.startswith(HIDDEN_PREFIX):
+            raise ERR_PERMISSION_DENIED
+        # Do NOT verify the signature here — it is kept with the auth
+        # data for future use (reference: server.go:385).
+        try:
+            rdata = self.storage.read(variable, 0)
+            if pkt.parse(rdata).t != 0:
+                raise ERR_EXIST  # can't overwrite the password
+        except ERR_NOT_FOUND:
+            pass
+        self.storage.write(variable, 0, req)
+        return None
+
+    def _authenticate(self, req: bytes, peer, sender) -> bytes:
+        phase, variable, adata = pkt.parse_auth_request(req)
+        variable = variable or b""
+        a = self._auth.get(variable)
+        if a is None:
+            try:
+                rdata = self.storage.read(variable, 0)
+            except ERR_NOT_FOUND:
+                raise ERR_NO_AUTHENTICATION_DATA from None
+            rauth = pkt.parse(rdata).auth
+            if rauth is None:
+                raise ERR_NO_AUTHENTICATION_DATA
+            # Pre-sign our collective-signature share now; it is only
+            # released when all auth phases succeed
+            # (reference: server.go:425-434).
+            share = self.crypt.collective.sign(self.crypt.signer, variable)
+            proof = pkt.serialize_signature(share)
+            a = authmod.AuthServer(rauth, proof)
+            self._auth[variable] = a
+        # Unlike the reference (server.go:441-447, which deletes the
+        # AuthServer on done *and* on error), the AuthServer stays in
+        # the map: the anti-brute-force counter must span client
+        # sessions or repeated wrong-password runs would each start
+        # from attempts=0, and a concurrent client mid-handshake must
+        # not lose its per-session DH state.  Per-session state is
+        # LRU-bounded inside AuthServer.
+        try:
+            res, done = a.make_response(
+                phase, adata or b"", session=(peer or sender).id
+            )
+        except ERR_TOO_MANY_ATTEMPTS:
+            log.warning(
+                "server [%s]: auth: too many attempts from %s",
+                self.self_node.name,
+                getattr(peer or sender, "name", "?"),
+            )
+            raise
+        if done:
+            a.reset_attempts()  # successful login clears the penalty
+        return res
+
+    # -- enrollment (reference: server.go:450-514) ------------------------
+
+    def _register(self, req: bytes, peer, sender) -> bytes | None:
+        p = pkt.parse(req)
+        variable, value, t, sig, ss = p.variable or b"", p.value, p.t, p.sig, p.ss
+        if sig is None or ss is None:
+            raise ERR_MALFORMED_REQUEST
+        if variable.startswith(HIDDEN_PREFIX):
+            raise ERR_PERMISSION_DENIED
+
+        issuer = sigmod.issuer(sig, self.crypt.keyring)
+        tbs = pkt.tbs(req)
+        sigmod.verify_with_certificate(tbs, sig, issuer)
+
+        # The proof: a collective signature over the uid variable.
+        self.crypt.collective.verify(
+            variable, ss, self.qs.choose_quorum(qm.AUTH), self.crypt.keyring
+        )
+
+        ret = None
+        certs = certmod.parse(value or b"")
+        if certs:
+            c = certs[0]  # take the first one only
+            if c.uid.encode() != variable:
+                raise ERR_INVALID_USER_ID
+            certmod.sign_certificate(c, self.crypt.signer.key)
+            ret = c.serialize()
+
+        # Persist to settle the auth-setup process, inheriting any
+        # stored auth params (reference: server.go:497-513).
+        rauth = None
+        try:
+            rdata = self.storage.read(variable, 0)
+            rauth = pkt.parse(rdata).auth
+        except ERR_NOT_FOUND:
+            pass
+        stored = pkt.serialize(variable, value, t, sig, ss, rauth)
+        self.storage.write(variable, t, stored)
+        return ret
+
+    # -- distributed crypto (reference: server.go:516-541) ----------------
+
+    def _distribute(self, req: bytes, peer, sender) -> bytes | None:
+        p = pkt.parse(req)
+        self.storage.write(
+            HIDDEN_PREFIX + (p.variable or b""), 0, p.value or b""
+        )
+        return None
+
+    def _dist_sign(self, req: bytes, peer, sender) -> bytes | None:
+        p = pkt.parse(req)
+        params = self.storage.read(HIDDEN_PREFIX + (p.variable or b""), 0)
+        return self.threshold.sign(
+            params, p.value, (peer or sender).id, self.self_node.id
+        )
+
+    # -- revocation (reference: server.go:543-560) ------------------------
+
+    def _revoke(self, req: bytes, peer, sender) -> bytes | None:
+        nodes = certmod.parse(req)
+        for n in nodes:
+            if peer is not None and n.id == peer.id:
+                self.self_node.revoke(n)
+        return None
+
+    def _notify(self, req: bytes, peer, sender) -> bytes | None:
+        return None  # no-op, as in the reference
+
+    _handlers = {
+        tp.JOIN: _join,
+        tp.LEAVE: _leave,
+        tp.TIME: _time,
+        tp.READ: _read,
+        tp.WRITE: _write,
+        tp.SIGN: _sign,
+        tp.AUTH: _authenticate,
+        tp.SETAUTH: _set_auth,
+        tp.DISTRIBUTE: _distribute,
+        tp.DISTSIGN: _dist_sign,
+        tp.REGISTER: _register,
+        tp.REVOKE: _revoke,
+        tp.NOTIFY: _notify,
+    }
+
+
+def _listen_addr(addr: str) -> str:
+    """Certificate addresses look like ``http://host:port`` or
+    ``loop://name``; the transport start wants the listen side
+    (reference: server.go:49-53 keeps only the port)."""
+    return addr.split("://", 1)[-1]

@@ -34,6 +34,12 @@ class MemStorage:
                 raise ERR_NOT_FOUND
             return value
 
+    def versions(self, variable: bytes) -> list[int]:
+        """All stored timestamps for ``variable`` (ascending)."""
+        with self._lock:
+            entry = self._data.get(variable)
+            return list(entry[0]) if entry else []
+
     def write(self, variable: bytes, t: int, value: bytes) -> None:
         with self._lock:
             entry = self._data.get(variable)

@@ -70,3 +70,20 @@ class PlainStorage:
             with open(tmp, "wb") as f:
                 f.write(value)
             os.replace(tmp, fn)
+
+    def versions(self, variable: bytes) -> list[int]:
+        """All stored timestamps for ``variable`` (ascending)."""
+        prefix = self._prefix(variable) + "."
+        out = []
+        with self._lock:
+            try:
+                names = os.listdir(self.path)
+            except FileNotFoundError:
+                return out
+            for name in names:
+                if name.startswith(prefix) and not name.endswith(".tmp"):
+                    try:
+                        out.append(int(name[len(prefix) :]))
+                    except ValueError:
+                        continue
+        return sorted(out)

@@ -1,0 +1,211 @@
+"""Programmatic key/topology generation — the GnuPG script replacement.
+
+The reference builds its test universe with shell + GnuPG
+(scripts/setup.sh:17-48, gen.sh, clique.sh, trust.sh): server cliques
+are pairwise cross-signed keys, trust edges are directed key
+signatures living in *each node's own keyring*, and the node address
+rides inside the PGP uid comment.  Here the same topology is built
+programmatically: RSA keys, compact certificates with first-class
+address fields, explicit cross-sign / sign helpers, and per-principal
+keyring views.
+
+Canonical shape (mirrors setup.sh):
+- ``n`` quorum servers (a01…) pairwise cross-signed into one clique;
+- ``n_rw`` storage-only nodes (rw01…) that each sign every quorum
+  server in their own view (``trust.sh -t signer rwXX a*``) — they are
+  not cross-signed, so they form the READ-quorum complement;
+- users sign the first ``n-(f+1)`` servers and every rw node in their
+  own view (``trust.sh -t signer uXX a0[1-6] rw*``);
+- the last ``f+1`` servers counter-sign each user's certificate so
+  users carry a valid quorum certificate (``trust.sh -t signee a07 u01
+  …``; u04 deliberately left unsigned for TOFU tests →
+  ``unsigned_users``).
+
+Keeping the user→server edges out of the shared certificates is
+essential: they exist only in the signer's own keyring, exactly as
+with GnuPG.  A universal shared view would create spurious
+bidirectional user↔server edges that poison the unique-maximal-clique
+assumption (reference: graph.go:347-355).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from bftkv_tpu.crypto import cert as certmod
+from bftkv_tpu.crypto import new_crypto, rsa
+from bftkv_tpu.graph import Graph
+from bftkv_tpu.quorum.wotqs import WotQS
+
+__all__ = [
+    "Identity",
+    "new_identity",
+    "cross_sign",
+    "sign",
+    "Universe",
+    "build_universe",
+    "make_node",
+]
+
+
+@dataclass
+class Identity:
+    """One principal: private key + its certificate."""
+
+    name: str
+    key: rsa.PrivateKey
+    cert: certmod.Certificate
+
+    @property
+    def id(self) -> int:
+        return self.cert.id
+
+
+def new_identity(
+    name: str, address: str = "", uid: str = "", bits: int = 2048
+) -> Identity:
+    key = rsa.generate(bits)
+    cert = certmod.Certificate(
+        n=key.n, e=key.e, name=name, address=address, uid=uid or name
+    )
+    # Self-signature, as gpg does on generation.
+    certmod.sign_certificate(cert, key)
+    return Identity(name=name, key=key, cert=cert)
+
+
+def cross_sign(members: list[Identity]) -> None:
+    """Pairwise cross-sign: every member signs every other member's
+    certificate — a trust clique (reference: scripts/clique.sh)."""
+    for a in members:
+        for b in members:
+            if a is not b:
+                certmod.sign_certificate(b.cert, a.key)
+
+
+def sign(signer: Identity, signee: Identity) -> None:
+    """Directed trust edge signer→signee (reference: scripts/sign.sh)."""
+    certmod.sign_certificate(signee.cert, signer.key)
+
+
+@dataclass
+class Universe:
+    servers: list[Identity]
+    storage_nodes: list[Identity] = field(default_factory=list)
+    users: list[Identity] = field(default_factory=list)
+    # ids of the servers that counter-sign user certs (a07–a10 analog);
+    # users trust the *other* servers.
+    cert_signer_ids: set[int] = field(default_factory=set)
+
+    @property
+    def all(self) -> list[Identity]:
+        return self.servers + self.storage_nodes + self.users
+
+    def certs(self) -> list[certmod.Certificate]:
+        return [i.cert for i in self.all]
+
+    def view_of(self, identity: Identity) -> list[certmod.Certificate]:
+        """``identity``'s keyring view: private certificate copies with
+        this principal's own trust edges added — and no one else's."""
+        own = certmod.parse(certmod.serialize_many(self.certs()))
+        by_id = {c.id: c for c in own}
+        server_ids = {s.id for s in self.servers}
+        rw_ids = {s.id for s in self.storage_nodes}
+        if any(u.id == identity.id for u in self.users):
+            for c in own:
+                if (
+                    c.id in server_ids and c.id not in self.cert_signer_ids
+                ) or c.id in rw_ids:
+                    certmod.sign_certificate(c, identity.key)
+        elif identity.id in rw_ids:
+            for c in own:
+                if c.id in server_ids:
+                    certmod.sign_certificate(c, identity.key)
+        return list(by_id.values())
+
+
+def build_universe(
+    n_servers: int = 4,
+    n_users: int = 1,
+    n_rw: int = 0,
+    *,
+    scheme: str = "loop",
+    base_port: int = 6001,
+    rw_base_port: int = 6101,
+    bits: int = 2048,
+    unsigned_users: int = 0,
+) -> Universe:
+    """The canonical test topology (reference: scripts/setup.sh:17-48).
+
+    ``unsigned_users``: how many trailing users get *no* server
+    counter-signatures — they carry no quorum certificate, the TOFU /
+    registration test subject (reference: u04 / test1).
+    """
+
+    def addr(name: str, port: int) -> str:
+        if scheme == "loop":
+            return f"loop://{name}"
+        return f"http://127.0.0.1:{port}"
+
+    servers = [
+        new_identity(
+            f"a{i + 1:02d}",
+            address=addr(f"a{i + 1:02d}", base_port + i),
+            uid=f"a{i + 1:02d}@server",
+            bits=bits,
+        )
+        for i in range(n_servers)
+    ]
+    cross_sign(servers)
+
+    storage_nodes = [
+        new_identity(
+            f"rw{i + 1:02d}",
+            address=addr(f"rw{i + 1:02d}", rw_base_port + i),
+            uid=f"rw{i + 1:02d}@storage",
+            bits=bits,
+        )
+        for i in range(n_rw)
+    ]
+
+    f = (n_servers - 1) // 3
+    cert_signers = servers[-(f + 1) :] if servers else []
+
+    users = []
+    for i in range(n_users):
+        name = f"u{i + 1:02d}"
+        u = new_identity(name, uid=f"{name}@example.com", bits=bits)
+        # The user's own trust edges are added per-view by
+        # :meth:`Universe.view_of`, never onto the shared certs.
+        if i < n_users - unsigned_users:
+            for s in cert_signers:
+                sign(s, u)  # quorum certificate on the user's cert
+        users.append(u)
+
+    return Universe(
+        servers=servers,
+        storage_nodes=storage_nodes,
+        users=users,
+        cert_signer_ids={s.id for s in cert_signers},
+    )
+
+
+def make_node(identity: Identity, view: list[certmod.Certificate]):
+    """Wire one node: trust graph with ``identity`` as self, every
+    other principal in ``view`` as a peer, and a crypto bundle whose
+    keyring holds the whole view (reference: cmd/bftkv/main.go:124-141
+    builds the same triple from the pubring/secring files).
+
+    ``view`` is typically :meth:`Universe.view_of`; pass pre-parsed
+    private copies — nodes must not share mutable certificate state.
+    """
+    self_cert = next(c for c in view if c.id == identity.cert.id)
+
+    graph = Graph()
+    graph.set_self_nodes([self_cert])
+    graph.add_peers([c for c in view if c.id != self_cert.id])
+
+    crypt = new_crypto(identity.key, self_cert)
+    crypt.keyring.register(view)
+
+    qs = WotQS(graph)
+    return graph, crypt, qs

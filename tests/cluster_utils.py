@@ -1,0 +1,72 @@
+"""In-process cluster fixtures: N quorum servers + M storage nodes +
+clients on the loopback transport — the reference's tier-3 pattern of
+running every server in one process (reference: protocol/test_utils.go:24-82,
+topology from scripts/setup.sh)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from bftkv_tpu import topology
+from bftkv_tpu.protocol.client import Client
+from bftkv_tpu.protocol.server import Server
+from bftkv_tpu.storage.memkv import MemStorage
+from bftkv_tpu.transport.loopback import LoopbackNet, TrLoopback
+
+
+@dataclass
+class Cluster:
+    universe: topology.Universe
+    net: LoopbackNet
+    servers: list[Server] = field(default_factory=list)  # quorum (a*)
+    storage_servers: list[Server] = field(default_factory=list)  # rw*
+    clients: list[Client] = field(default_factory=list)
+
+    @property
+    def all_servers(self) -> list[Server]:
+        return self.servers + self.storage_servers
+
+    def stop(self) -> None:
+        for s in self.all_servers:
+            s.tr.stop()
+
+    def server_named(self, name: str) -> Server:
+        idents = self.universe.servers + self.universe.storage_nodes
+        for ident, srv in zip(idents, self.all_servers):
+            if ident.name == name:
+                return srv
+        raise KeyError(name)
+
+
+def start_cluster(
+    n_servers: int = 4,
+    n_users: int = 1,
+    n_rw: int = 4,
+    *,
+    bits: int = 2048,
+    unsigned_users: int = 0,
+    storage_factory=MemStorage,
+    server_cls=Server,
+    client_cls=Client,
+    transport_cls=TrLoopback,
+) -> Cluster:
+    uni = topology.build_universe(
+        n_servers, n_users, n_rw, scheme="loop", bits=bits,
+        unsigned_users=unsigned_users,
+    )
+    net = LoopbackNet()
+    cluster = Cluster(universe=uni, net=net)
+    for ident in uni.servers + uni.storage_nodes:
+        graph, crypt, qs = topology.make_node(ident, uni.view_of(ident))
+        tr = transport_cls(crypt, net)
+        srv = server_cls(graph, qs, tr, crypt, storage_factory())
+        srv.start()
+        if ident in uni.servers:
+            cluster.servers.append(srv)
+        else:
+            cluster.storage_servers.append(srv)
+    for ident in uni.users:
+        graph, crypt, qs = topology.make_node(ident, uni.view_of(ident))
+        tr = transport_cls(crypt, net)
+        cluster.clients.append(client_cls(graph, qs, tr, crypt))
+    return cluster

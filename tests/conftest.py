@@ -1,14 +1,15 @@
-"""Test configuration: run JAX on a virtual 8-device CPU mesh.
+"""Test configuration: run JAX on a virtual multi-device CPU mesh.
 
 Real TPU hardware in CI has a single chip; all sharding tests use
-``--xla_force_host_platform_device_count=8`` so multi-chip layouts
-compile and execute without real chips.
+``--xla_force_host_platform_device_count=N`` (default 8, override with
+``BFTKV_TEST_DEVICES``) so multi-chip layouts compile and execute
+without real chips.
 
 The ambient environment may pre-import jax with an accelerator
 platform selected (sitecustomize PJRT plugin registration), so env
 vars alone are not enough — :mod:`bftkv_tpu.hostcpu` repairs the
-already-imported jax in-process.  An explicit TPU lane can opt out
-with ``BFTKV_TPU_LANE=1``.
+already-imported jax in-process.  The real-TPU lane opts out with
+``BFTKV_TPU_LANE=1``.
 """
 
 import os
@@ -16,4 +17,4 @@ import os
 if os.environ.get("BFTKV_TPU_LANE") != "1":
     from bftkv_tpu.hostcpu import force_cpu
 
-    force_cpu(8)
+    force_cpu(int(os.environ.get("BFTKV_TEST_DEVICES", "8")))

@@ -1,0 +1,123 @@
+"""TPA roaming + threshold-CA through live servers
+(reference: protocol/roaming_test.go:15-29, dist_test.go:29-105)."""
+
+from __future__ import annotations
+
+import hashlib
+
+import pytest
+
+from bftkv_tpu.crypto import rsa
+from bftkv_tpu.crypto.threshold import ThresholdAlgo
+from bftkv_tpu.errors import Error
+
+from cluster_utils import start_cluster
+
+BITS = 2048
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = start_cluster(n_servers=4, n_users=2, n_rw=4, bits=BITS)
+    yield c
+    c.stop()
+
+
+def test_tpa_roundtrip(cluster):
+    """First authenticate sets up the shared secret; a later one (the
+    'roaming' device) recovers the same cipher key
+    (reference: roaming_test.go:15-29)."""
+    cli = cluster.clients[0]
+    proof, key = cli.authenticate(b"tpa_var", b"correct horse")
+    assert proof is not None and key
+    proof2, key2 = cli.authenticate(b"tpa_var", b"correct horse")
+    assert key2 == key
+
+
+def test_tpa_wrong_password(cluster):
+    cli = cluster.clients[0]
+    cli.authenticate(b"tpa_wp", b"right password")
+    with pytest.raises(Error):
+        cli.authenticate(b"tpa_wp", b"wrong password")
+
+
+def test_tpa_protected_write_read(cluster):
+    """The proof gates reads on servers that hold the auth params —
+    the quorum servers, which stored them at setAuth/sign time
+    (reference: server.go:181-185; full value secrecy additionally
+    comes from API-layer symmetric encryption, api.go:149-163)."""
+    from bftkv_tpu import packet as pkt
+    from bftkv_tpu.errors import ERR_AUTHENTICATION_FAILURE
+
+    cli = cluster.clients[0]
+    proof, _key = cli.authenticate(b"tpa_rw", b"pw1")
+    cli.write(b"tpa_rw", b"secret-value", proof=proof)
+    assert cli.read(b"tpa_rw", proof=proof) == b"secret-value"
+    # A quorum server holds the auth params (stored at setAuth/sign
+    # time) and refuses any read of the protected variable without the
+    # proof; with the proof it answers (with no completed version —
+    # W = U − {Ci} + R keeps completed writes off the clique servers,
+    # reference: wotqs.go:108-110).
+    srv = cluster.servers[0]
+    with pytest.raises(ERR_AUTHENTICATION_FAILURE):
+        srv._read(pkt.serialize(b"tpa_rw", None, 0, None, None), None, None)
+    raw = srv._read(pkt.serialize(b"tpa_rw", None, 0, None, proof), None, None)
+    assert raw is None  # in-progress sign record only, never completed
+
+
+def test_threshold_rsa_ca(cluster):
+    """Distribute an RSA CA key, threshold-sign, verify against the
+    public key (reference: dist_test.go:29-105)."""
+    cli = cluster.clients[0]
+    key = rsa.generate(2048)
+    cli.distribute("ca-rsa", key)
+    tbs = b"an X.509 to-be-signed blob"
+    sig = cli.dist_sign("ca-rsa", tbs, ThresholdAlgo.RSA, "sha256")
+    assert rsa.verify_host(tbs, sig, key.public)
+
+
+def test_threshold_dsa_ca(cluster):
+    from bftkv_tpu.crypto.threshold import dsa as tdsa
+
+    cli = cluster.clients[0]
+    key = tdsa.generate(1024)
+    cli.distribute("ca-dsa", key)
+    tbs = b"dsa signing payload"
+    sig = cli.dist_sign("ca-dsa", tbs, ThresholdAlgo.DSA, "sha256")
+    # standard DSA verify: v = (g^u1 · y^u2 mod p) mod q == r
+    size = (key.q.bit_length() + 7) // 8
+    r = int.from_bytes(sig[:size], "big")
+    s = int.from_bytes(sig[size:], "big")
+    assert 0 < r < key.q and 0 < s < key.q
+    ops = tdsa._DSAGroupOps(key.p, key.q, key.g)
+    m = ops.os2i(hashlib.sha256(tbs).digest())
+    w = pow(s, -1, key.q)
+    v = (
+        pow(key.g, m * w % key.q, key.p)
+        * pow(key.y, r * w % key.q, key.p)
+    ) % key.p % key.q
+    assert v == r
+
+
+def test_threshold_ecdsa_ca(cluster):
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec as cec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        encode_dss_signature,
+    )
+
+    from bftkv_tpu.crypto import ec
+    from bftkv_tpu.crypto.threshold import ecdsa as tec
+
+    cli = cluster.clients[0]
+    key = tec.generate(ec.P256)
+    cli.distribute("ca-ec", key)
+    tbs = b"ecdsa signing payload"
+    sig = cli.dist_sign("ca-ec", tbs, ThresholdAlgo.ECDSA, "sha256")
+    r = int.from_bytes(sig[:32], "big")
+    s = int.from_bytes(sig[32:], "big")
+    pub = key.curve.scalar_base_mult(key.d)
+    pubkey = cec.EllipticCurvePublicNumbers(
+        pub[0], pub[1], cec.SECP256R1()
+    ).public_key()
+    pubkey.verify(encode_dss_signature(r, s), tbs, cec.ECDSA(hashes.SHA256()))
