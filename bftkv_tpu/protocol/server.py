@@ -93,11 +93,14 @@ class Server(Protocol):
         # (the reference's nil peer, server.go:566-569).
         peer = self.crypt.keyring.get(sender.id)
 
-        h = self._handlers.get(cmd)
-        if h is None:
+        name = self._handlers.get(cmd)
+        if name is None:
             raise ERR_UNKNOWN_COMMAND
         metrics.incr(f"server.{tp.COMMAND_NAMES.get(cmd, cmd)}.count")
-        res = h(self, plain, peer, sender)
+        # Dispatch by name so subclasses (the Byzantine Mal* family,
+        # reference: malserver_test.go:23-194) override handlers by
+        # plain method definition.
+        res = getattr(self, name)(plain, peer, sender)
         return self.crypt.message.encrypt([sender], res or b"", nonce)
 
     # -- membership (reference: server.go:64-120) -------------------------
@@ -488,19 +491,19 @@ class Server(Protocol):
         return None  # no-op, as in the reference
 
     _handlers = {
-        tp.JOIN: _join,
-        tp.LEAVE: _leave,
-        tp.TIME: _time,
-        tp.READ: _read,
-        tp.WRITE: _write,
-        tp.SIGN: _sign,
-        tp.AUTH: _authenticate,
-        tp.SETAUTH: _set_auth,
-        tp.DISTRIBUTE: _distribute,
-        tp.DISTSIGN: _dist_sign,
-        tp.REGISTER: _register,
-        tp.REVOKE: _revoke,
-        tp.NOTIFY: _notify,
+        tp.JOIN: "_join",
+        tp.LEAVE: "_leave",
+        tp.TIME: "_time",
+        tp.READ: "_read",
+        tp.WRITE: "_write",
+        tp.SIGN: "_sign",
+        tp.AUTH: "_authenticate",
+        tp.SETAUTH: "_set_auth",
+        tp.DISTRIBUTE: "_distribute",
+        tp.DISTSIGN: "_dist_sign",
+        tp.REGISTER: "_register",
+        tp.REVOKE: "_revoke",
+        tp.NOTIFY: "_notify",
     }
 
 

@@ -189,6 +189,64 @@ def build_universe(
     )
 
 
+def save_home(path: str, identity: Identity, view: list[certmod.Certificate]) -> None:
+    """Persist one principal's home directory: ``pubring`` (its whole
+    certificate view) + ``secring`` (its private key) — the layout the
+    daemon/CLI load, replacing the reference's per-node GnuPG key dirs
+    (reference: scripts/gen.sh, cmd/bftkv/main.go:69-72)."""
+    import os
+
+    from bftkv_tpu.crypto.keyring import Keyring
+
+    os.makedirs(path, exist_ok=True)
+    ring = Keyring()
+    # The principal's own cert goes first: consumers take pubring[0]
+    # as the owner's cert (reference: api.go:63-66 reads peer
+    # pubrings and signs certs[0]).
+    ordered = sorted(view, key=lambda c: c.id != identity.cert.id)
+    ring.register(ordered, priv=identity.key)
+    ring.save_pubring(os.path.join(path, "pubring"))
+    ring.save_secring(os.path.join(path, "secring"))
+
+
+def load_home(path: str):
+    """Load a home directory saved by :func:`save_home`; returns the
+    ``(graph, crypt, qs)`` triple with self = the cert matching the
+    secring key (reference: cmd/bftkv/main.go:124-141)."""
+    import os
+
+    from bftkv_tpu.crypto import Crypto
+    from bftkv_tpu.crypto.keyring import Keyring
+    from bftkv_tpu.crypto.message import MessageSecurity
+    from bftkv_tpu.crypto.signature import CollectiveSignature, Signer
+
+    ring = Keyring()
+    view = ring.load_pubring(os.path.join(path, "pubring"))
+    ring.load_secring(os.path.join(path, "secring"))
+    self_cert = None
+    key = None
+    for c in view:
+        try:
+            key = ring.private_key(c.id)
+            self_cert = c
+            break
+        except Exception:
+            continue
+    if self_cert is None or key is None:
+        raise FileNotFoundError(f"no self key found under {path}")
+
+    graph = Graph()
+    graph.set_self_nodes([self_cert])
+    graph.add_peers([c for c in view if c.id != self_cert.id])
+    crypt = Crypto(
+        keyring=ring,
+        signer=Signer(key, self_cert),
+        message=MessageSecurity(key, self_cert),
+        collective=CollectiveSignature(),
+    )
+    return graph, crypt, WotQS(graph)
+
+
 def make_node(identity: Identity, view: list[certmod.Certificate]):
     """Wire one node: trust graph with ``identity`` as self, every
     other principal in ``view`` as a peer, and a crypto bundle whose

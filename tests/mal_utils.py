@@ -1,0 +1,157 @@
+"""Byzantine fault-injection harness: malicious server / storage /
+client, by subclassing — never mocking — exactly as the reference does
+(reference: protocol/malserver_test.go:23-194, malstorage_test.go:19-115,
+malclient_test.go:83-189)."""
+
+from __future__ import annotations
+
+from bftkv_tpu import packet as pkt
+from bftkv_tpu import quorum as qm
+from bftkv_tpu import transport as tp
+from bftkv_tpu.errors import ERR_INSUFFICIENT_NUMBER_OF_QUORUM
+from bftkv_tpu.protocol import majority_error
+from bftkv_tpu.protocol.client import Client
+from bftkv_tpu.protocol.server import Server
+from bftkv_tpu.storage.memkv import MemStorage
+
+
+class MalStorage(MemStorage):
+    """Keeps *conflicting* values in a side area instead of refusing
+    them (reference: malstorage_test.go:19-115)."""
+
+    def __init__(self):
+        super().__init__()
+        self.mal: dict[tuple[bytes, int], list[bytes]] = {}
+
+    def mal_write(self, variable: bytes, t: int, value: bytes) -> None:
+        self.mal.setdefault((variable, t), []).append(value)
+        # the latest conflicting write shadows the honest record
+        super().write(variable, t, value)
+
+
+class MalServer(Server):
+    """A colluding server: for addresses in ``mal_addresses`` it signs
+    anything (no writer-sig verify, no quorum certificate, no
+    equivocation check) and stores unverified double-writes
+    (reference: malserver_test.go:55-116)."""
+
+    mal_addresses: set[str] = set()
+
+    @property
+    def _is_mal(self) -> bool:
+        return self.self_node.address in self.mal_addresses
+
+    def _sign(self, req: bytes, peer, sender):
+        if not self._is_mal:
+            return super()._sign(req, peer, sender)
+        # sign whatever arrives (reference: malSign, :64-89)
+        pkt.parse(req)
+        tbss = pkt.tbss(req)
+        share = self.crypt.collective.sign(self.crypt.signer, tbss)
+        return pkt.serialize_signature(share)
+
+    def _write(self, req: bytes, peer, sender):
+        if not self._is_mal:
+            return super()._write(req, peer, sender)
+        # store without any verification (reference: malWrite, :91-112)
+        p = pkt.parse(req)
+        if isinstance(self.storage, MalStorage):
+            self.storage.mal_write(p.variable or b"", p.t, req)
+        else:
+            self.storage.write(p.variable or b"", p.t, req)
+        return None
+
+
+class MalClient(Client):
+    """The textbook equivocator: writes <x,t,v> to one half of each
+    quorum plus the colluders, and <x,t,v'> to the other half plus the
+    colluders (reference: malclient_test.go:83-189)."""
+
+    def __init__(self, *args, mal_addresses: set[str] = frozenset(), **kw):
+        super().__init__(*args, **kw)
+        self.mal_addresses = set(mal_addresses)
+
+    def _split(self, nodes: list) -> tuple[list, list, list]:
+        """(honest-half-1, honest-half-2, colluders) — honest nodes
+        interleaved (reference: getGroup, malclient_test.go:61-81)."""
+        h1: list = []
+        h2: list = []
+        colluders: list = []
+        flip = True
+        for n in nodes:
+            if n.address in self.mal_addresses:
+                colluders.append(n)
+            elif flip:
+                h1.append(n)
+                flip = False
+            else:
+                h2.append(n)
+                flip = True
+        return h1, h2, colluders
+
+    def write_mal(self, variable: bytes, v1: bytes, v2: bytes) -> None:
+        """Equivocate: both values at the same timestamp
+        (reference: WriteMal, malclient_test.go:83-127)."""
+        q = self.qs.choose_quorum(qm.AUTH)
+        maxt = 0
+        actives: list = []
+        failure: list = []
+
+        def cb(res: tp.MulticastResponse) -> bool:
+            nonlocal maxt
+            if res.err is None and res.data and len(res.data) <= 8:
+                t = int.from_bytes(res.data, "big")
+                maxt = max(maxt, t)
+                actives.append(res.peer)
+                return q.is_threshold(actives)
+            failure.append(res.peer)
+            return q.reject(failure)
+
+        self.tr.multicast(tp.TIME, q.nodes(), variable, cb)
+        if not q.is_threshold(actives):
+            raise ERR_INSUFFICIENT_NUMBER_OF_QUORUM
+        t = maxt + 1
+
+        s1, s2, smal = self._split(q.nodes())
+        rq = self.qs.choose_quorum(qm.WRITE)
+        r1, r2, rmal = self._split(rq.nodes())
+
+        self._sign_and_write(s1 + smal, r1 + rmal, variable, v1, t, q)
+        self._sign_and_write(s2 + smal, r2 + rmal, variable, v2, t, q)
+
+    def _sign_and_write(
+        self, sign_group, write_group, variable, value, t, q
+    ) -> None:
+        """(reference: signAndWrite, malclient_test.go:129-189)."""
+        tbs = pkt.serialize(variable, value, t, nfields=3)
+        sig = self.crypt.signer.issue(tbs)
+        tbss = pkt.serialize(variable, value, t, sig, nfields=4)
+        ss = self.crypt.collective.sign(self.crypt.signer, tbss)
+        req = pkt.serialize(variable, value, t, sig, None)
+        failure: list = []
+        errs: list = []
+
+        def cb(res: tp.MulticastResponse) -> bool:
+            nonlocal ss
+            if res.err is None and res.data is not None:
+                try:
+                    share = pkt.parse_signature(res.data)
+                    ss, done = self.crypt.collective.combine(
+                        ss, share, q, self.crypt.keyring
+                    )
+                    return done
+                except Exception as e:
+                    errs.append(e)
+            else:
+                errs.append(res.err)
+            failure.append(res.peer)
+            return q.reject(failure)
+
+        self.tr.multicast(tp.SIGN, sign_group, req, cb)
+        try:
+            self.crypt.collective.verify(tbss, ss, q, self.crypt.keyring)
+        except Exception as e:
+            raise majority_error(errs, e)
+
+        wreq = pkt.serialize(variable, value, t, sig, ss)
+        self.tr.multicast(tp.WRITE, write_group, wreq, lambda res: False)

@@ -1,0 +1,103 @@
+"""API-layer tests: open/register/password RW/update_cert
+(reference: api/api_test.go:48-162)."""
+
+from __future__ import annotations
+
+import pytest
+
+from bftkv_tpu import api as apimod
+from bftkv_tpu import topology
+from bftkv_tpu.errors import Error
+from bftkv_tpu.transport.loopback import TrLoopback
+
+from cluster_utils import start_cluster
+
+BITS = 2048
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = start_cluster(n_servers=4, n_users=1, n_rw=4, bits=BITS)
+    yield c
+    c.stop()
+
+
+@pytest.fixture(scope="module")
+def homes(cluster, tmp_path_factory):
+    """Home dirs for every server + a virgin user (reference: test1)."""
+    tmp_path = tmp_path_factory.mktemp("homes")
+    uni = cluster.universe
+    paths = {}
+    for ident in uni.servers + uni.storage_nodes:
+        p = str(tmp_path / ident.name)
+        topology.save_home(p, ident, uni.view_of(ident))
+        paths[ident.name] = p
+    virgin = topology.new_identity(
+        "test1", uid="test1@example.com", bits=BITS
+    )
+    p = str(tmp_path / "test1")
+    topology.save_home(p, virgin, [virgin.cert])
+    paths["test1"] = p
+    return paths
+
+
+def test_register_enrolls_a_virgin_user(cluster, homes):
+    """A fresh identity with zero counter-signatures registers, gains a
+    quorum certificate, and can then write (reference: api_test.go:48-140)."""
+    factory = lambda crypt: TrLoopback(crypt, cluster.net)
+    api = apimod.open_client(homes["test1"], factory, join=False)
+
+    # before registering, a write must be rejected (no quorum cert)
+    api._sign_peers([homes[s.name] for s in cluster.universe.servers])
+    with pytest.raises(Error):
+        api.client.write(b"api_prereg", b"x")
+
+    # the reference registers against a* AND rw* (api_test.go:24-41)
+    certlist = [
+        homes[i.name]
+        for i in cluster.universe.servers + cluster.universe.storage_nodes
+    ]
+    api.register(certlist, "s3cret")
+
+    self_cert = api.crypt.keyring.lookup(api.graph.id)
+    assert len(self_cert.signers()) >= 3  # self + >= f+1 servers
+
+    # now the quorum certificate check passes
+    api.write(b"api_postreg", b"registered!")
+    assert api.read(b"api_postreg") == b"registered!"
+
+
+def test_password_protected_write_read(cluster, tmp_path):
+    uni = cluster.universe
+    user = uni.users[0]
+    # build the signed user's home on the fly
+    d = str(tmp_path / "u01-home")
+    topology.save_home(d, user, uni.view_of(user))
+    factory = lambda crypt: TrLoopback(crypt, cluster.net)
+    api = apimod.open_client(d, factory, join=False)
+
+    api.write(b"api_pw_var", b"top secret", password="hunter2")
+    assert api.read(b"api_pw_var", password="hunter2") == b"top secret"
+    # the stored value is ciphertext, not the plaintext
+    raw = api.client.read(
+        b"api_pw_var",
+        api.client.authenticate(b"api_pw_var", b"hunter2")[0],
+    )
+    assert raw != b"top secret"
+    # wrong password fails
+    with pytest.raises(Error):
+        api.read(b"api_pw_var", password="wrong")
+
+
+def test_update_cert_rewrites_pubring(cluster, tmp_path):
+    uni = cluster.universe
+    user = uni.users[0]
+    d = str(tmp_path / "u01-home")
+    topology.save_home(d, user, uni.view_of(user))
+    factory = lambda crypt: TrLoopback(crypt, cluster.net)
+    api = apimod.open_client(d, factory, join=False)
+    api.update_cert()
+    # reload: the pubring must still parse and contain the whole view
+    graph, crypt, qs = topology.load_home(d)
+    assert graph.id == user.id
+    assert len(graph.get_peers()) >= len(uni.servers)

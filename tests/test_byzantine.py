@@ -1,0 +1,113 @@
+"""Byzantine scenarios: collusion/equivocation with revocation of
+double-signers, honest-reader convergence
+(reference: protocol/mal_test.go:23-71, malclient_test.go,
+malserver_test.go; BASELINE's "zero additional safety violations")."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from bftkv_tpu import topology
+from bftkv_tpu.errors import Error
+from bftkv_tpu.transport.loopback import TrLoopback
+
+from cluster_utils import start_cluster
+from mal_utils import MalClient, MalServer, MalStorage
+
+BITS = 2048
+N_SERVERS = 7
+N_RW = 6
+
+
+@pytest.fixture()
+def mal_cluster():
+    c = start_cluster(
+        n_servers=N_SERVERS,
+        n_users=2,
+        n_rw=N_RW,
+        bits=BITS,
+        server_cls=MalServer,
+        storage_factory=MalStorage,
+    )
+    # colluders: the last 3 quorum servers + the last 2 storage nodes
+    mal = {i.cert.address for i in c.universe.servers[-3:]}
+    mal |= {i.cert.address for i in c.universe.storage_nodes[-2:]}
+    MalServer.mal_addresses = mal
+    try:
+        yield c, mal
+    finally:
+        MalServer.mal_addresses = set()
+        c.stop()
+
+
+def test_collusion_convergence_and_revocation(mal_cluster):
+    """A malicious client + colluding servers equivocate <x,t,v>/<x,t,v'>;
+    an honest reader still converges to a single value and revokes the
+    double-signers (reference: mal_test.go:23-71)."""
+    c, mal = mal_cluster
+    uni = c.universe
+
+    # the equivocator drives user 0's identity
+    evil_ident = uni.users[0]
+    graph, crypt, qs = topology.make_node(evil_ident, uni.view_of(evil_ident))
+    evil = MalClient(
+        graph, qs, TrLoopback(crypt, c.net), crypt, mal_addresses=mal
+    )
+    evil.write_mal(b"mal_var", b"value-one", b"value-two")
+
+    # an honest reader converges (one of the two equivocated values)
+    honest = c.clients[1]
+    value = honest.read(b"mal_var")
+    assert value in (b"value-one", b"value-two")
+
+    # … and revokes every signer that signed both values: the colluding
+    # quorum servers (their shares are in both collective signatures)
+    deadline = time.time() + 5
+    mal_server_ids = {i.cert.id for i in uni.servers[-3:]}
+    while time.time() < deadline:
+        revoked = set(honest.self_node.revoked)
+        if mal_server_ids <= revoked:
+            break
+        time.sleep(0.05)
+    assert mal_server_ids <= set(honest.self_node.revoked), (
+        "colluding double-signers must be revoked on read"
+    )
+    # the equivocating writer signed both values too
+    assert evil_ident.cert.id in honest.self_node.revoked
+
+
+def test_honest_write_survives_colluders(mal_cluster):
+    """With ≤f colluders misbehaving, honest quorum writes/reads still
+    succeed (the b-masking guarantee)."""
+    c, mal = mal_cluster
+    honest = c.clients[1]
+    honest.write(b"sane_var", b"sane value")
+    assert honest.read(b"sane_var") == b"sane value"
+
+
+def test_same_uid_may_overwrite(mal_cluster):
+    """TOFU allows a different key with the SAME uid to overwrite
+    (reference: server.go:329-337 — id *or* uid match; mal_test.go
+    TestTOFU 'trusted entity overwrite successful (same UId)')."""
+    c, _ = mal_cluster
+    uni = c.universe
+    owner = c.clients[1]
+    owner.write(b"tofu_uid_var", b"original")
+
+    # a fresh identity with the same uid, counter-signed by the quorum
+    u2 = uni.users[1]
+    alias = topology.new_identity("alias", uid=u2.cert.uid, bits=BITS)
+    for s in uni.servers[-3:]:
+        topology.sign(s, alias)
+    uni.users.append(alias)
+    try:
+        graph, crypt, qs = topology.make_node(alias, uni.view_of(alias))
+        twin = type(owner)(graph, qs, TrLoopback(crypt, c.net), crypt)
+        # servers must learn the alias cert (gossip, as a real client would)
+        twin.joining()
+        twin.write(b"tofu_uid_var", b"overwritten by same uid")
+        assert twin.read(b"tofu_uid_var") == b"overwritten by same uid"
+    finally:
+        uni.users.remove(alias)
