@@ -143,7 +143,17 @@ class CollectiveSignature:
             certs.append(c)
         if not items:
             raise ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES
-        ok = self.verifier.verify_batch(items)
+        # Route through the cross-request batching dispatcher when one
+        # is installed: concurrent server handlers then share device
+        # launches (SURVEY §7 phase 5).
+        from bftkv_tpu.ops import dispatch
+
+        d = dispatch.get()
+        ok = (
+            d.verify(items)
+            if d is not None
+            else self.verifier.verify_batch(items)
+        )
         valid = {c for c, good in zip(certs, ok) if good}
         if not quorum.is_sufficient(list(valid)):
             raise ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES

@@ -1,0 +1,170 @@
+"""Cross-request batching dispatcher — the TPU verification sidecar.
+
+The reference verifies signatures one at a time inside each request
+handler (crypto_pgp.go:485-500 called from server.go:207,300).  On TPU
+that wastes the device: a single RSA-2048 e=65537 verify is ~17 modmuls
+over 64 limbs — three orders of magnitude below a v5e's appetite.  The
+dispatcher turns per-request verify calls from *concurrent* server
+handlers into shared device launches:
+
+- callers submit their (message, sig, key) batches and block on a
+  future;
+- a collector thread flushes when ``max_batch`` items are pending or
+  ``max_wait`` elapsed since the first pending item (latency floor for
+  low load — SURVEY §7 hard part 2);
+- one ``VerifierDomain.verify_batch`` launch serves every caller in the
+  flush; results are scattered back to the futures.
+
+Deployment stance: replicas are mutually distrusting, so a dispatcher
+serves exactly one replica's trust domain (or an in-process cluster in
+tests/benchmarks, where the host is one trust domain by construction).
+Batch-occupancy and latency are exported through
+:mod:`bftkv_tpu.metrics` as ``dispatch.batch`` / ``dispatch.wait``.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from bftkv_tpu.metrics import registry as metrics
+
+__all__ = ["VerifyDispatcher", "install", "uninstall", "get"]
+
+
+class _Pending:
+    __slots__ = ("items", "event", "result", "error")
+
+    def __init__(self, items):
+        self.items = items
+        self.event = threading.Event()
+        self.result = None
+        self.error: Exception | None = None
+
+
+class VerifyDispatcher:
+    """Accumulates verify requests across threads into device batches."""
+
+    def __init__(self, verifier=None, *, max_batch: int = 1024, max_wait: float = 0.002):
+        if verifier is None:
+            from bftkv_tpu.crypto import rsa as rsamod
+
+            verifier = rsamod.VerifierDomain()
+        self.verifier = verifier
+        self.max_batch = max_batch
+        self.max_wait = max_wait
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: list[_Pending] = []
+        self._queued_items = 0
+        self._running = False
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "VerifyDispatcher":
+        with self._lock:
+            if self._running:
+                return self
+            self._running = True
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cv:
+            self._running = False
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- caller side ------------------------------------------------------
+
+    def verify(self, items: list) -> np.ndarray:
+        """Blocking batched verify; safe from any thread."""
+        if not items:
+            return np.zeros((0,), dtype=bool)
+        if not self._running:
+            return self.verifier.verify_batch(items)
+        p = _Pending(items)
+        t0 = time.perf_counter()
+        with self._cv:
+            self._queue.append(p)
+            self._queued_items += len(items)
+            self._cv.notify_all()
+        p.event.wait()
+        metrics.observe("dispatch.wait", time.perf_counter() - t0)
+        if p.error is not None:
+            raise p.error
+        return p.result
+
+    # -- collector --------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while self._running and not self._queue:
+                    self._cv.wait()
+                if not self._running and not self._queue:
+                    return
+                # Wait for more work up to max_wait after the first
+                # pending item, unless the batch target is already met.
+                deadline = time.monotonic() + self.max_wait
+                while (
+                    self._running
+                    and self._queued_items < self.max_batch
+                    and (remaining := deadline - time.monotonic()) > 0
+                ):
+                    self._cv.wait(timeout=remaining)
+                batch = self._queue
+                self._queue = []
+                self._queued_items = 0
+            self._flush(batch)
+
+    def _flush(self, batch: list[_Pending]) -> None:
+        flat = [it for p in batch for it in p.items]
+        metrics.observe("dispatch.batch", len(flat))
+        metrics.incr("dispatch.flushes")
+        metrics.incr("dispatch.verifies", len(flat))
+        try:
+            ok = self.verifier.verify_batch(flat)
+        except Exception as e:
+            for p in batch:
+                p.error = e
+                p.event.set()
+            return
+        off = 0
+        for p in batch:
+            p.result = ok[off : off + len(p.items)]
+            off += len(p.items)
+            p.event.set()
+
+
+_global: VerifyDispatcher | None = None
+_global_lock = threading.Lock()
+
+
+def install(dispatcher: VerifyDispatcher | None = None) -> VerifyDispatcher:
+    """Install (and start) the process-wide dispatcher; verification
+    call sites (``CollectiveSignature.verify``) route through it."""
+    global _global
+    with _global_lock:
+        if _global is not None:
+            _global.stop()
+        _global = (dispatcher or VerifyDispatcher()).start()
+        return _global
+
+
+def uninstall() -> None:
+    global _global
+    with _global_lock:
+        if _global is not None:
+            _global.stop()
+            _global = None
+
+
+def get() -> VerifyDispatcher | None:
+    return _global
