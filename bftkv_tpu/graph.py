@@ -30,6 +30,7 @@ boolean reductions on device (``bftkv_tpu.ops.tally``) — the
 from __future__ import annotations
 
 import logging
+import threading
 from collections import deque
 from dataclasses import dataclass, field
 
@@ -56,9 +57,23 @@ class Graph:
         self.vertices: dict[int, Vertex] = {}
         self.revoked: dict[int, object | None] = {}
         self.self_vertices: list[Vertex] = []
+        # Bumped on every structural mutation; quorum systems key their
+        # clique/quorum caches on it so choose_quorum is O(1) between
+        # membership changes (the reference rediscovers cliques on every
+        # call — O(V²) per write phase, wotqs.go:117-127). Mutations can
+        # come from concurrent server handler threads (join/revoke), so
+        # the bump is locked — a lost increment would let a stale cached
+        # quorum survive a membership change.
+        self.generation = 0
+        self._gen_lock = threading.Lock()
+
+    def _bump_generation(self) -> None:
+        with self._gen_lock:
+            self.generation += 1
 
     # -- construction (graph.go:46-146) -----------------------------------
     def add_nodes(self, nodes: list) -> list:
+        self._bump_generation()
         res = []
         for n in nodes:
             skid = n.id
@@ -90,6 +105,7 @@ class Graph:
             self.self_vertices.append(v)
 
     def remove_nodes(self, nodes: list) -> None:
+        self._bump_generation()
         for n in nodes:
             nid = n.id
             for v in self.vertices.values():
@@ -118,6 +134,7 @@ class Graph:
         self.remove_nodes(peers)
 
     def revoke(self, n) -> None:
+        self._bump_generation()
         v = self.vertices.get(n.id)
         instance = None
         if v is not None:
@@ -126,6 +143,7 @@ class Graph:
         self.revoked[n.id] = instance
 
     def revoke_nodes(self, nodes: list) -> None:
+        self._bump_generation()
         for n in nodes:
             self.revoked[n.id] = n
 

@@ -87,14 +87,19 @@ class VerifyDispatcher:
         """Blocking batched verify; safe from any thread."""
         if not items:
             return np.zeros((0,), dtype=bool)
-        if not self._running:
-            return self.verifier.verify_batch(items)
         p = _Pending(items)
         t0 = time.perf_counter()
         with self._cv:
-            self._queue.append(p)
-            self._queued_items += len(items)
-            self._cv.notify_all()
+            # _running is checked under the lock: a stop() racing with an
+            # unlocked check could let the collector exit after the check
+            # but before the append, stranding this entry forever.
+            running = self._running
+            if running:
+                self._queue.append(p)
+                self._queued_items += len(items)
+                self._cv.notify_all()
+        if not running:
+            return self.verifier.verify_batch(items)
         p.event.wait()
         metrics.observe("dispatch.wait", time.perf_counter() - t0)
         if p.error is not None:

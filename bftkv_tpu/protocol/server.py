@@ -29,6 +29,7 @@ requests.
 from __future__ import annotations
 
 import logging
+import threading
 
 from bftkv_tpu import packet as pkt
 from bftkv_tpu import quorum as qm
@@ -70,6 +71,7 @@ class Server(Protocol):
         super().__init__(self_node, qs, tr, crypt)
         self.storage = storage
         self._auth: dict[bytes, authmod.AuthServer] = {}
+        self._auth_lock = threading.Lock()
 
     # -- lifecycle (reference: server.go:47-62) ---------------------------
 
@@ -384,7 +386,8 @@ class Server(Protocol):
     def _authenticate(self, req: bytes, peer, sender) -> bytes:
         phase, variable, adata = pkt.parse_auth_request(req)
         variable = variable or b""
-        a = self._auth.get(variable)
+        with self._auth_lock:
+            a = self._auth.get(variable)
         if a is None:
             try:
                 rdata = self.storage.read(variable, 0)
@@ -399,7 +402,11 @@ class Server(Protocol):
             share = self.crypt.collective.sign(self.crypt.signer, variable)
             proof = pkt.serialize_signature(share)
             a = authmod.AuthServer(rauth, proof)
-            self._auth[variable] = a
+            # Two racing first requests may both construct; exactly one
+            # instance wins so per-session DH state never splits across
+            # copies.
+            with self._auth_lock:
+                a = self._auth.setdefault(variable, a)
         # Unlike the reference (server.go:441-447, which deletes the
         # AuthServer on done *and* on error), the AuthServer stays in
         # the map: the anti-brute-force counter must span client

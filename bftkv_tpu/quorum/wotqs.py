@@ -26,6 +26,7 @@ batched device tallies in ``bftkv_tpu.ops.tally`` for bulk paths
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -138,10 +139,21 @@ class WotQuorum:
 
 
 class WotQS:
-    """The quorum system over a trust graph (wotqs.go:32-34)."""
+    """The quorum system over a trust graph (wotqs.go:32-34).
+
+    Quorums are memoized per (access-type, graph generation): the
+    reference rediscovers maximal cliques on every ``ChooseQuorum`` —
+    O(V²) work called 3+ times per write — which dominates at 64–256
+    replicas. Membership changes bump ``graph.generation`` and
+    invalidate the cache; per-node ``active`` flips need no
+    invalidation because ``WotQuorum.nodes()`` re-filters on each call.
+    """
 
     def __init__(self, graph):
         self.g = graph
+        self._cache: dict[int, WotQuorum] = {}
+        self._cache_gen: int | None = None
+        self._cache_lock = threading.Lock()
 
     def _new_qc(self, nodes: list, weight: int, rw: int) -> QC | None:
         if rw & q.PEER:
@@ -193,10 +205,30 @@ class WotQS:
         return WotQuorum(qcs)
 
     def choose_quorum(self, rw: int) -> WotQuorum:
+        gen = getattr(self.g, "generation", None)
+        with self._cache_lock:
+            if gen is None or gen != self._cache_gen:
+                self._cache.clear()
+                self._cache_gen = gen
+            else:
+                quorum = self._cache.get(rw)
+                if quorum is not None:
+                    return quorum
         if rw & q.CERT:
             distance = 0
         elif rw & q.AUTH:
             distance = 1
         else:
             distance = 2
-        return self._quorum_from(rw, self.g.get_self_id(), distance)
+        quorum = self._quorum_from(rw, self.g.get_self_id(), distance)
+        if gen is not None:
+            with self._cache_lock:
+                # Store only if the graph did not mutate while we were
+                # computing — a quorum built from the pre-mutation graph
+                # must not be served under the post-mutation generation.
+                if (
+                    self._cache_gen == gen
+                    and getattr(self.g, "generation", None) == gen
+                ):
+                    self._cache[rw] = quorum
+        return quorum

@@ -41,3 +41,16 @@ class Storage(Protocol):
     def write(self, variable: bytes, t: int, value: bytes) -> None:
         """Store ``value`` as version ``t`` of ``variable``."""
         ...
+
+    def versions(self, variable: bytes) -> list[int]:
+        """All stored version timestamps for ``variable`` (any order;
+        empty if unknown).
+
+        Part of the storage contract: the server's read path scans back
+        past in-progress sign records with it (the reference walks the
+        leveldb key range the same way, storage/leveldb/leveldb.go:30-46).
+        A backend without it degrades to a bounded countdown that cannot
+        reach completed versions more than 1024 timestamps behind an
+        incomplete write-once record.
+        """
+        ...

@@ -28,12 +28,14 @@ def _load() -> ctypes.CDLL:
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH):
-            subprocess.run(
-                ["make", "-s"],
-                cwd=os.path.abspath(_NATIVE_DIR),
-                check=True,
-            )
+        # Always invoke make: the Makefile dependency on kvstore.cpp makes
+        # an up-to-date build a no-op, and a stale prebuilt .so would
+        # otherwise fail symbol binding below when the C ABI grows.
+        subprocess.run(
+            ["make", "-s"],
+            cwd=os.path.abspath(_NATIVE_DIR),
+            check=True,
+        )
         lib = ctypes.CDLL(_LIB_PATH)
         lib.kv_open.restype = ctypes.c_void_p
         lib.kv_open.argtypes = [ctypes.c_char_p]
@@ -55,6 +57,14 @@ def _load() -> ctypes.CDLL:
             ctypes.c_uint64,
             ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.kv_versions.restype = ctypes.c_int64
+        lib.kv_versions.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64,
         ]
         _lib = lib
         return lib
@@ -101,6 +111,22 @@ class NativeStorage:
             if n2 < 0 or n2 != n:
                 raise ERR_STORAGE_IO
             return buf.raw[: int(n)]
+
+    def versions(self, variable: bytes) -> list[int]:
+        """All stored version timestamps, descending (storage contract —
+        the server read path's scan past in-progress sign records)."""
+        with self._lock:
+            cap = 64
+            while True:
+                buf = (ctypes.c_uint64 * cap)()
+                n = self._lib.kv_versions(
+                    self._handle, variable, len(variable), buf, cap
+                )
+                if n < 0:
+                    return []
+                if n <= cap:
+                    return list(buf[: int(n)])
+                cap = int(n)
 
     def write(self, variable: bytes, t: int, value: bytes) -> None:
         with self._lock:

@@ -108,6 +108,24 @@ int kv_write(Store* s, const uint8_t* var, uint32_t varlen, uint64_t t,
   return 0;
 }
 
+// Writes up to cap version timestamps (descending) into out; returns the
+// total number of stored versions, or -1 if the variable is unknown.
+// Call with cap == 0 to size, then again with a large-enough buffer
+// (mirrors the leveldb key-range walk, leveldb.go:30-46).
+int64_t kv_versions(Store* s, const uint8_t* var, uint32_t varlen,
+                    uint64_t* out, uint64_t cap) {
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->index.find(std::string((const char*)var, varlen));
+  if (it == s->index.end()) return -1;
+  const std::map<uint64_t, Slot>& versions = it->second;
+  uint64_t i = 0;
+  for (auto vit = versions.rbegin(); vit != versions.rend() && i < cap;
+       ++vit, ++i) {
+    out[i] = vit->first;
+  }
+  return (int64_t)versions.size();
+}
+
 // t == 0 means latest. Returns value length, or -1 if not found, or -2 on
 // I/O error. If out is non-null it must have room for the value (call once
 // with out == nullptr to size, then again to fetch; *t_out gets the

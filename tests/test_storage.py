@@ -43,6 +43,28 @@ def test_versions_and_latest(store):
         store.read(b"x", 4)
 
 
+def test_versions_listing(store):
+    # versions() is part of the storage contract (the server read path's
+    # scan past in-progress sign records; reference: leveldb.go:30-46).
+    assert store.versions(b"x") == []
+    store.write(b"x", 1, b"v1")
+    store.write(b"x", 3, b"v3")
+    store.write(b"x", 2**64 - 1, b"once")
+    assert sorted(store.versions(b"x")) == [1, 3, 2**64 - 1]
+    assert store.versions(b"other") == []
+
+
+def test_native_versions_survive_reopen(tmp_path):
+    path = str(tmp_path / "db.log")
+    s = NativeStorage(path)
+    for t in range(1, 100):
+        s.write(b"x", t, b"v%d" % t)
+    s.close()
+    s = NativeStorage(path)
+    assert sorted(s.versions(b"x")) == list(range(1, 100))
+    s.close()
+
+
 def test_overwrite_same_t(store):
     store.write(b"x", 5, b"a")
     store.write(b"x", 5, b"b")
