@@ -1,0 +1,446 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Measures the BASELINE.json config matrix on the default JAX backend
+(the bench environment's real TPU; never forces CPU):
+
+- batched RSA-2048 e=65537 verify kernel throughput at batch
+  {256, 1024, 4096} vs the single-core host ``pow`` baseline
+  (reference hot loop: crypto/pgp/crypto_pgp.go:485-500);
+- full-exponent modexp (threshold-RSA partial signing / TPA DH,
+  reference: crypto/threshold/rsa/rsa.go:140-178);
+- signed writes/sec + p50/p99 write latency through in-process
+  clusters (4 / 16 / 64 replicas) with the cross-request verify
+  dispatcher installed — the analog of the reference's only perf
+  instrument, ``TestManyWrites``/``TestManyReads``
+  (protocol/rw_test.go:65-109) and ``scripts/test.go:36-58``;
+- batched revoke-on-read equivocation tally at 256 simulated
+  replicas (BASELINE config 5).
+
+Headline metric: signed writes/sec on the largest cluster measured;
+``vs_baseline`` is the ratio against BASELINE.json's 50k-writes/sec
+north star. Everything else rides in ``extra``.
+
+Env knobs: BENCH_CONFIGS=kernel,c4,c16,c64,tally  BENCH_WRITERS=N
+BENCH_WRITES=N  BENCH_KERNEL_BATCHES=256,1024,4096  BENCH_FAST=1
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, REPO)
+
+NORTH_STAR_WRITES_PER_SEC = 50_000.0
+
+FAST = os.environ.get("BENCH_FAST") == "1"
+
+
+def _env_list(name: str, default: str) -> list[str]:
+    return [s for s in os.environ.get(name, default).split(",") if s]
+
+
+# ---------------------------------------------------------------------------
+# Kernel benchmarks
+# ---------------------------------------------------------------------------
+
+
+def _verify_operands(batch: int, nlimbs: int = 128):
+    """(sig, em, n, n', r2) arrays for a batch of genuine signatures.
+
+    Signs a small distinct set on host and tiles it: verification cost
+    is identical for repeated rows, and host signing 4096 items would
+    dominate setup time.
+    """
+    from bftkv_tpu.crypto import rsa
+    from bftkv_tpu.ops import bigint, limb
+
+    key = rsa.generate(nlimbs * 16)
+    dom = bigint.MontgomeryDomain(key.n, nlimbs)
+    base = min(batch, 32)
+    sigs, ems = [], []
+    for i in range(base):
+        msg = b"bench-%d" % i
+        s = int.from_bytes(rsa.sign(msg, key), "big")
+        em = rsa.emsa_pkcs1v15_sha256(msg, key.size_bytes)
+        sigs.append(limb.int_to_limbs(s, nlimbs))
+        ems.append(limb.int_to_limbs(em, nlimbs))
+    reps = -(-batch // base)
+    sig = np.tile(np.stack(sigs), (reps, 1))[:batch]
+    em = np.tile(np.stack(ems), (reps, 1))[:batch]
+    rep = lambda row: np.broadcast_to(row, (batch, nlimbs)).copy()
+    return key, sig, em, rep(dom.n), rep(dom.n_prime), rep(dom.r2), rep(dom.one_mont)
+
+
+def bench_kernel_verify(batches: list[int]) -> dict:
+    """Device verifies/sec per batch size + host pow baseline."""
+    import jax
+
+    from bftkv_tpu.ops import rsa as rsa_ops
+
+    out: dict = {"batch": {}}
+    key, sig, em, n, npr, r2, _one = _verify_operands(max(batches))
+    for b in sorted(batches):
+        args = [jax.device_put(a[:b]) for a in (sig, em, n, npr, r2)]
+        t0 = time.perf_counter()
+        ok = np.asarray(rsa_ops.verify_batch_e65537(*args))
+        compile_s = time.perf_counter() - t0
+        assert ok.all(), "bench verify kernel returned false on genuine sigs"
+        # Timed iterations on device-resident operands.
+        iters, elapsed = 0, 0.0
+        t0 = time.perf_counter()
+        while elapsed < (0.5 if FAST else 2.0) or iters < 3:
+            jax.block_until_ready(rsa_ops.verify_batch_e65537(*args))
+            iters += 1
+            elapsed = time.perf_counter() - t0
+        rate = b * iters / elapsed
+        out["batch"][str(b)] = {
+            "verifies_per_sec": round(rate, 1),
+            "first_call_s": round(compile_s, 2),
+            "iters": iters,
+        }
+    # Host single-core baseline: raw pow() as the reference's math/big does.
+    from bftkv_tpu.ops import limb
+
+    s_int = limb.limbs_to_ints(sig[:64])
+    em_int = limb.limbs_to_ints(em[:64])
+    t0 = time.perf_counter()
+    for s, e in zip(s_int, em_int):
+        assert pow(s, 65537, key.n) == e
+    host_rate = 64 / (time.perf_counter() - t0)
+    out["host_pow_verifies_per_sec"] = round(host_rate, 1)
+    best = max(v["verifies_per_sec"] for v in out["batch"].values())
+    out["best_verifies_per_sec"] = best
+    out["speedup_vs_host_pow"] = round(best / host_rate, 2)
+    return out
+
+
+def bench_kernel_modexp(batch: int = 256) -> dict:
+    """Full 2048-bit-exponent modexp (threshold-RSA partial sign / TPA)."""
+    import jax
+
+    from bftkv_tpu.ops import limb
+    from bftkv_tpu.ops import rsa as rsa_ops
+
+    key, sig, _em, n, npr, r2, one = _verify_operands(batch)
+    e = np.broadcast_to(limb.int_to_limbs(key.d, 128), (batch, 128)).copy()
+    args = [jax.device_put(a) for a in (sig, e, n, npr, r2, one)]
+    t0 = time.perf_counter()
+    jax.block_until_ready(rsa_ops.power_batch(*args))
+    compile_s = time.perf_counter() - t0
+    iters, elapsed = 0, 0.0
+    t0 = time.perf_counter()
+    while elapsed < (0.5 if FAST else 2.0) or iters < 2:
+        jax.block_until_ready(rsa_ops.power_batch(*args))
+        iters += 1
+        elapsed = time.perf_counter() - t0
+    rate = batch * iters / elapsed
+    # Host baseline on 8 items.
+    s_int = limb.limbs_to_ints(sig[:8])
+    t0 = time.perf_counter()
+    for s in s_int:
+        pow(s, key.d, key.n)
+    host_rate = 8 / (time.perf_counter() - t0)
+    return {
+        "batch": batch,
+        "modexps_per_sec": round(rate, 1),
+        "host_pow_modexps_per_sec": round(host_rate, 1),
+        "speedup_vs_host_pow": round(rate / host_rate, 2),
+        "first_call_s": round(compile_s, 2),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Cluster benchmarks (the TestManyWrites/TestManyReads analog)
+# ---------------------------------------------------------------------------
+
+
+def _warm_items(count: int) -> list:
+    """Synthetic (message, sig, key) triples for bucket warm-up."""
+    from bftkv_tpu.crypto import rsa
+
+    key = rsa.generate(2048)
+    msg = b"bench-warm"
+    sig = rsa.sign(msg, key)
+    return [(msg, sig, key.public)] * count
+
+
+def _make_cluster(n_servers: int, n_rw: int, n_users: int, storage_factory):
+    from bftkv_tpu import topology
+    from bftkv_tpu.protocol.client import Client
+    from bftkv_tpu.protocol.server import Server
+    from bftkv_tpu.transport.loopback import LoopbackNet, TrLoopback
+
+    uni = topology.build_universe(n_servers, n_users, n_rw, scheme="loop")
+    net = LoopbackNet()
+    servers = []
+    for ident in uni.servers + uni.storage_nodes:
+        graph, crypt, qs = topology.make_node(ident, uni.view_of(ident))
+        srv = Server(graph, qs, TrLoopback(crypt, net), crypt, storage_factory())
+        srv.start()
+        servers.append(srv)
+    clients = []
+    for ident in uni.users:
+        graph, crypt, qs = topology.make_node(ident, uni.view_of(ident))
+        clients.append(Client(graph, qs, TrLoopback(crypt, net), crypt))
+    return servers, clients
+
+
+def bench_cluster(
+    n_servers: int,
+    n_rw: int,
+    writers: int,
+    writes_per_writer: int,
+    *,
+    value_size: int = 1024,
+    dispatch_batch: int = 256,
+    storage: str = "mem",
+    read_fraction: float = 0.0,
+) -> dict:
+    """Signed writes/sec (+ optional read mix) through a live in-process
+    cluster with the verify dispatcher installed."""
+    import tempfile
+
+    from bftkv_tpu.metrics import registry as metrics
+    from bftkv_tpu.ops import dispatch
+
+    tmp = None
+    if storage == "plain":
+        from bftkv_tpu.storage.plain import PlainStorage
+
+        tmp = tempfile.TemporaryDirectory(prefix="bftkv-bench-")
+        counter = [0]
+
+        def storage_factory():
+            counter[0] += 1
+            path = os.path.join(tmp.name, f"db{counter[0]}")
+            return PlainStorage(path)
+
+    else:
+        from bftkv_tpu.storage.memkv import MemStorage
+
+        storage_factory = MemStorage
+
+    t_setup = time.perf_counter()
+    servers, clients = _make_cluster(n_servers, n_rw, writers, storage_factory)
+    setup_s = time.perf_counter() - t_setup
+
+    metrics.reset()
+    dispatch.install(dispatch.VerifyDispatcher(max_batch=dispatch_batch))
+    value = os.urandom(value_size)
+    # Warm the protocol path and the device bucket shapes the run can hit
+    # (pays XLA compilation outside the timed region). A write burst at n
+    # replicas produces ~n·suff verifies, padded to power-of-two buckets.
+    clients[0].write(b"bench/warmup", value)
+    clients[0].read(b"bench/warmup")
+    d = dispatch.get()
+    expected_burst = n_servers * max(1, (2 * ((n_servers - 1) // 3) + 1)) * writers
+    bucket = 256
+    warm_items = _warm_items(bucket_max := min(
+        8192, 1 << (max(256, expected_burst) - 1).bit_length()
+    ))
+    while bucket <= bucket_max:
+        if bucket >= d.verifier.host_threshold:
+            d.verifier.verify_batch(warm_items[:bucket])
+        bucket *= 2
+    metrics.reset()
+
+    errors: list = []
+    n_reads = [0]
+
+    def run(ci: int, client) -> None:
+        rng = np.random.default_rng(ci)
+        try:
+            reads_per_write = (
+                read_fraction / (1 - read_fraction) if read_fraction else 0.0
+            )
+            for i in range(writes_per_writer):
+                client.write(b"bench/%d/%d" % (ci, i), value)
+                k = int(reads_per_write)
+                if rng.random() < reads_per_write - k:
+                    k += 1
+                for _ in range(k):
+                    client.read(b"bench/%d/%d" % (ci, rng.integers(0, i + 1)))
+                    n_reads[0] += 1
+        except Exception as e:  # surfaced below; bench must not hang
+            errors.append(e)
+
+    threads = [
+        threading.Thread(target=run, args=(ci, c), daemon=True)
+        for ci, c in enumerate(clients[:writers])
+    ]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.perf_counter() - t0
+    if errors:
+        raise errors[0]
+
+    total_writes = writers * writes_per_writer
+    # Correctness spot check before reporting a rate.
+    got = clients[0].read(b"bench/0/%d" % (writes_per_writer - 1))
+    assert got == value, "read-back mismatch"
+
+    snap = metrics.snapshot()
+    flushes = snap.get("dispatch.flushes", 0)
+    res = {
+        "replicas": n_servers,
+        "rw_nodes": n_rw,
+        "writers": writers,
+        "writes": total_writes,
+        "reads": n_reads[0],
+        "value_bytes": value_size,
+        "storage": storage,
+        "writes_per_sec": round(total_writes / elapsed, 2),
+        "ops_per_sec": round((total_writes + n_reads[0]) / elapsed, 2),
+        "write_p50_s": round(snap.get("client.write.latency.p50", 0), 4),
+        "write_p99_s": round(snap.get("client.write.latency.p99", 0), 4),
+        "read_p50_s": round(snap.get("client.read.latency.p50", 0), 4),
+        "dispatch_flushes": flushes,
+        "dispatch_verifies": snap.get("dispatch.verifies", 0),
+        "dispatch_batch_mean": round(
+            snap.get("dispatch.verifies", 0) / flushes, 2
+        )
+        if flushes
+        else 0,
+        "dispatch_batch_p50": snap.get("dispatch.batch.p50", 0),
+        "verifies_host": snap.get("verify.host", 0),
+        "verifies_device": snap.get("verify.device", 0),
+        "setup_s": round(setup_s, 1),
+    }
+    dispatch.uninstall()
+    for s in servers:
+        s.tr.stop()
+    if tmp is not None:
+        tmp.cleanup()
+    return res
+
+
+# ---------------------------------------------------------------------------
+# Batched revoke-on-read tally (BASELINE config 5)
+# ---------------------------------------------------------------------------
+
+
+def bench_tally(universe: int = 256, n_byz: int = 85, batch: int = 4096) -> dict:
+    """Equivocation tally over 256 simulated replicas, f=85 colluders."""
+    import jax
+
+    from bftkv_tpu.ops import tally
+
+    rng = np.random.default_rng(7)
+    honest = np.zeros((2, universe), dtype=bool)
+    honest[0, : universe // 2] = True
+    honest[1, universe // 2 : universe - n_byz] = True
+    byz = np.zeros((2, universe), dtype=bool)
+    byz[:, universe - n_byz :] = True  # colluders sign both values
+    signer_sets = honest | byz
+    mask = np.asarray(tally.equivocation_pairs(jax.device_put(signer_sets)))
+    assert mask.sum() == n_byz, (mask.sum(), n_byz)
+    # Throughput: batch of independent tallies via vmap.
+    sets = np.broadcast_to(signer_sets, (batch,) + signer_sets.shape).copy()
+    fn = jax.jit(jax.vmap(tally.equivocation_pairs))
+    jax.block_until_ready(fn(sets))
+    iters, elapsed = 0, 0.0
+    t0 = time.perf_counter()
+    while elapsed < (0.3 if FAST else 1.0) or iters < 3:
+        jax.block_until_ready(fn(sets))
+        iters += 1
+        elapsed = time.perf_counter() - t0
+    return {
+        "universe": universe,
+        "byzantine": n_byz,
+        "tallies_per_sec": round(batch * iters / elapsed, 1),
+        "detected": int(mask.sum()),
+    }
+
+
+# ---------------------------------------------------------------------------
+
+
+def main() -> None:
+    t_start = time.perf_counter()
+    import jax
+
+    try:  # persistent compile cache: repeat runs skip XLA compilation
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.path.expanduser("~/.cache/jax_bftkv"),
+        )
+    except Exception:
+        pass
+
+    extra: dict = {
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+        "devices": [str(d) for d in jax.devices()],
+        "fast_mode": FAST,
+    }
+
+    configs = _env_list(
+        "BENCH_CONFIGS",
+        "kernel,modexp,c4,c16,tally" if FAST else "kernel,modexp,c4,c16,c64,tally",
+    )
+    batches = [int(b) for b in _env_list("BENCH_KERNEL_BATCHES", "256,1024,4096")]
+    writers = int(os.environ.get("BENCH_WRITERS", "4" if FAST else "8"))
+    writes = int(os.environ.get("BENCH_WRITES", "4" if FAST else "16"))
+
+    if "kernel" in configs:
+        extra["verify_kernel"] = bench_kernel_verify(batches)
+    if "modexp" in configs:
+        extra["modexp_kernel"] = bench_kernel_modexp(64 if FAST else 256)
+
+    headline = None
+    if "c4" in configs:
+        extra["cluster_4"] = bench_cluster(
+            4, 4, writers, writes, storage="plain", dispatch_batch=256
+        )
+        headline = extra["cluster_4"]
+    if "c16" in configs:
+        extra["cluster_16"] = bench_cluster(
+            16, 4, writers, writes, storage="mem", dispatch_batch=256
+        )
+        headline = extra["cluster_16"]
+    if "c64" in configs:
+        extra["cluster_64"] = bench_cluster(
+            64, 0, writers, max(2, writes // 4), storage="mem", dispatch_batch=1024
+        )
+        headline = extra["cluster_64"]
+    if "tally" in configs:
+        extra["revoke_tally_256"] = bench_tally()
+
+    extra["total_s"] = round(time.perf_counter() - t_start, 1)
+
+    if headline is not None:
+        value = headline["writes_per_sec"]
+        metric = f"signed_writes_per_sec_{headline['replicas']}replica"
+    elif "verify_kernel" in extra:
+        value = extra["verify_kernel"]["best_verifies_per_sec"]
+        metric = "rsa2048_verifies_per_sec"
+    else:
+        value, metric = 0.0, "no_configs_selected"
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": value,
+                "unit": "writes/s" if headline else "verifies/s",
+                "vs_baseline": round(value / NORTH_STAR_WRITES_PER_SEC, 5)
+                if headline
+                else None,
+                "extra": extra,
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -16,6 +16,7 @@ from dataclasses import dataclass
 import numpy as np
 
 from bftkv_tpu.errors import ERR_INVALID_SIGNATURE
+from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.ops import bigint, limb
 
 # DigestInfo prefix for SHA-256 (RFC 8017 §9.2 note 1).
@@ -118,8 +119,21 @@ class VerifierDomain:
 
     _CACHE_MAX = 4096  # moduli are attacker-influenced (embedded certs)
 
-    def __init__(self, nlimbs: int = 128):
+    #: Below this many items a batch verifies on host: a device launch
+    #: costs ~tens of ms regardless of size, while a host e=65537 verify
+    #: is ~0.2 ms — the device only wins past a few hundred items. 0
+    #: forces everything through the kernel (tests, profiling).
+    HOST_CROSSOVER = 192
+
+    def __init__(self, nlimbs: int = 128, host_threshold: int | None = None):
         self.nlimbs = nlimbs
+        if host_threshold is None:
+            import os
+
+            host_threshold = int(
+                os.environ.get("BFTKV_HOST_VERIFY_THRESHOLD", self.HOST_CROSSOVER)
+            )
+        self.host_threshold = host_threshold
         self._cache: "OrderedDict[int, bigint.MontgomeryDomain | None]" = (
             OrderedDict()
         )
@@ -194,8 +208,31 @@ class VerifierDomain:
                     out[i] = key.n > 0 and verify_host(message, sig_bytes, key)
                 except Exception:
                     out[i] = False
-        if device_items:
+        if device_items and len(device_items) < self.host_threshold:
+            metrics.incr("verify.host", len(device_items))
+            for j, (message, sig_bytes, key) in zip(device_idx, device_items):
+                out[j] = verify_host(message, sig_bytes, key)
+        elif device_items:
+            metrics.incr("verify.device", len(device_items))
             sig, em, n, npr, r2 = self.assemble(device_items)
-            ok = np.asarray(rsa_ops.verify_batch_e65537(sig, em, n, npr, r2))
+            k = len(device_items)
+            # Pad to a power-of-two bucket (floor 256): the kernel is jitted
+            # per shape, and XLA compilation is expensive on TPU — without
+            # bucketing, every distinct flush size from the dispatcher would
+            # compile a fresh program. Pad rows reuse row 0's modulus with
+            # sig=0 vs row 0's em, which can never verify; they are sliced
+            # off.
+            padded = max(256, 1 << (k - 1).bit_length())
+            if padded != k:
+                def pad(a, fill_from_row0):
+                    extra = np.broadcast_to(
+                        a[0] if fill_from_row0 else np.zeros_like(a[0]),
+                        (padded - k,) + a.shape[1:],
+                    )
+                    return np.concatenate([a, extra], axis=0)
+
+                sig = pad(sig, False)
+                em, n, npr, r2 = (pad(a, True) for a in (em, n, npr, r2))
+            ok = np.asarray(rsa_ops.verify_batch_e65537(sig, em, n, npr, r2))[:k]
             out[np.asarray(device_idx)] = ok
         return out

@@ -135,7 +135,18 @@ class VerifyDispatcher:
         metrics.incr("dispatch.flushes")
         metrics.incr("dispatch.verifies", len(flat))
         try:
-            ok = self.verifier.verify_batch(flat)
+            if len(flat) <= self.max_batch:
+                ok = self.verifier.verify_batch(flat)
+            else:
+                # A burst can out-run the collector and drain as one
+                # oversized queue; chunk the device launches so padded
+                # batch shapes stay bounded by max_batch.
+                ok = np.concatenate(
+                    [
+                        self.verifier.verify_batch(flat[i : i + self.max_batch])
+                        for i in range(0, len(flat), self.max_batch)
+                    ]
+                )
         except Exception as e:
             for p in batch:
                 p.error = e

@@ -46,7 +46,9 @@ def test_sign_matches_cryptography_oracle(keys):
 
 
 def test_verify_batch_tpu(keys):
-    dom = rsa.VerifierDomain(nlimbs=128)
+    # host_threshold=0 forces the device kernel even for a small batch —
+    # this test also covers the power-of-two padding path (8 → 256 rows).
+    dom = rsa.VerifierDomain(nlimbs=128, host_threshold=0)
     msgs = [f"msg-{i}".encode() for i in range(6)]
     items = []
     for i, m in enumerate(msgs):
@@ -61,7 +63,7 @@ def test_verify_batch_tpu(keys):
 
 
 def test_verify_batch_oversize_sig(keys):
-    dom = rsa.VerifierDomain(nlimbs=128)
+    dom = rsa.VerifierDomain(nlimbs=128, host_threshold=0)
     key = keys[0]
     bad_sig = (key.n + 1).to_bytes(key.size_bytes + 1, "big")
     ok = dom.verify_batch([(b"m", bad_sig, key.public)])
@@ -71,3 +73,13 @@ def test_verify_batch_oversize_sig(keys):
 def test_verify_batch_empty():
     dom = rsa.VerifierDomain()
     assert rsa.VerifierDomain().verify_batch([]).shape == (0,)
+
+
+def test_verify_batch_host_crossover(keys):
+    """Small batches route to the host oracle (device launches only pay
+    off past a few hundred items); results are identical either way."""
+    dom = rsa.VerifierDomain(nlimbs=128, host_threshold=64)
+    key = keys[0]
+    sig = rsa.sign(b"m", key)
+    ok = dom.verify_batch([(b"m", sig, key.public), (b"x", sig, key.public)])
+    assert ok[0] and not ok[1]
