@@ -172,25 +172,20 @@ def _warm_items(count: int) -> list:
     return [(msg, sig, key.public)] * count
 
 
-def _make_cluster(n_servers: int, n_rw: int, n_users: int, storage_factory):
-    from bftkv_tpu import topology
-    from bftkv_tpu.protocol.client import Client
-    from bftkv_tpu.protocol.server import Server
-    from bftkv_tpu.transport.loopback import LoopbackNet, TrLoopback
+def _make_cluster(
+    n_servers: int, n_rw: int, n_users: int, storage_factory, transport: str = "loop"
+):
+    """One cluster builder for tests and bench: tests/cluster_utils."""
+    from tests.cluster_utils import start_cluster
 
-    uni = topology.build_universe(n_servers, n_users, n_rw, scheme="loop")
-    net = LoopbackNet()
-    servers = []
-    for ident in uni.servers + uni.storage_nodes:
-        graph, crypt, qs = topology.make_node(ident, uni.view_of(ident))
-        srv = Server(graph, qs, TrLoopback(crypt, net), crypt, storage_factory())
-        srv.start()
-        servers.append(srv)
-    clients = []
-    for ident in uni.users:
-        graph, crypt, qs = topology.make_node(ident, uni.view_of(ident))
-        clients.append(Client(graph, qs, TrLoopback(crypt, net), crypt))
-    return servers, clients
+    cluster = start_cluster(
+        n_servers,
+        n_users,
+        n_rw,
+        storage_factory=storage_factory,
+        transport=transport,
+    )
+    return cluster.all_servers, cluster.clients
 
 
 def bench_cluster(
@@ -203,6 +198,7 @@ def bench_cluster(
     dispatch_batch: int = 256,
     storage: str = "mem",
     read_fraction: float = 0.0,
+    transport: str = "loop",
 ) -> dict:
     """Signed writes/sec (+ optional read mix) through a live in-process
     cluster with the verify dispatcher installed."""
@@ -229,7 +225,9 @@ def bench_cluster(
         storage_factory = MemStorage
 
     t_setup = time.perf_counter()
-    servers, clients = _make_cluster(n_servers, n_rw, writers, storage_factory)
+    servers, clients = _make_cluster(
+        n_servers, n_rw, writers, storage_factory, transport
+    )
     setup_s = time.perf_counter() - t_setup
 
     metrics.reset()
@@ -241,11 +239,12 @@ def bench_cluster(
     clients[0].write(b"bench/warmup", value)
     clients[0].read(b"bench/warmup")
     d = dispatch.get()
-    expected_burst = n_servers * max(1, (2 * ((n_servers - 1) // 3) + 1)) * writers
+    # The dispatcher chunks flushes at max_batch, so the padded device
+    # shape never exceeds the next power of two above dispatch_batch —
+    # warming larger buckets would compile kernels the run cannot hit.
+    bucket_max = max(256, 1 << (dispatch_batch - 1).bit_length())
+    warm_items = _warm_items(bucket_max)
     bucket = 256
-    warm_items = _warm_items(bucket_max := min(
-        8192, 1 << (max(256, expected_burst) - 1).bit_length()
-    ))
     while bucket <= bucket_max:
         if bucket >= d.verifier.host_threshold:
             d.verifier.verify_batch(warm_items[:bucket])
@@ -253,7 +252,7 @@ def bench_cluster(
     metrics.reset()
 
     errors: list = []
-    n_reads = [0]
+    reads_by_thread = [0] * writers
 
     def run(ci: int, client) -> None:
         rng = np.random.default_rng(ci)
@@ -268,7 +267,7 @@ def bench_cluster(
                     k += 1
                 for _ in range(k):
                     client.read(b"bench/%d/%d" % (ci, rng.integers(0, i + 1)))
-                    n_reads[0] += 1
+                    reads_by_thread[ci] += 1
         except Exception as e:  # surfaced below; bench must not hang
             errors.append(e)
 
@@ -286,6 +285,7 @@ def bench_cluster(
         raise errors[0]
 
     total_writes = writers * writes_per_writer
+    total_reads = sum(reads_by_thread)
     # Correctness spot check before reporting a rate.
     got = clients[0].read(b"bench/0/%d" % (writes_per_writer - 1))
     assert got == value, "read-back mismatch"
@@ -297,11 +297,12 @@ def bench_cluster(
         "rw_nodes": n_rw,
         "writers": writers,
         "writes": total_writes,
-        "reads": n_reads[0],
+        "reads": total_reads,
         "value_bytes": value_size,
         "storage": storage,
+        "transport": transport,
         "writes_per_sec": round(total_writes / elapsed, 2),
-        "ops_per_sec": round((total_writes + n_reads[0]) / elapsed, 2),
+        "ops_per_sec": round((total_writes + total_reads) / elapsed, 2),
         "write_p50_s": round(snap.get("client.write.latency.p50", 0), 4),
         "write_p99_s": round(snap.get("client.write.latency.p99", 0), 4),
         "read_p50_s": round(snap.get("client.read.latency.p50", 0), 4),
@@ -387,7 +388,9 @@ def main() -> None:
 
     configs = _env_list(
         "BENCH_CONFIGS",
-        "kernel,modexp,c4,c16,tally" if FAST else "kernel,modexp,c4,c16,c64,tally",
+        "kernel,modexp,c4,c16,tally"
+        if FAST
+        else "kernel,modexp,c4,c4http,c16,c64,tally",
     )
     batches = [int(b) for b in _env_list("BENCH_KERNEL_BATCHES", "256,1024,4096")]
     writers = int(os.environ.get("BENCH_WRITERS", "4" if FAST else "8"))
@@ -404,6 +407,11 @@ def main() -> None:
             4, 4, writers, writes, storage="plain", dispatch_batch=256
         )
         headline = extra["cluster_4"]
+    if "c4http" in configs:
+        extra["cluster_4_http"] = bench_cluster(
+            4, 4, writers, writes, storage="mem", dispatch_batch=256,
+            transport="http",
+        )
     if "c16" in configs:
         extra["cluster_16"] = bench_cluster(
             16, 4, writers, writes, storage="mem", dispatch_batch=256

@@ -7,17 +7,23 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import itertools
+
 from bftkv_tpu import topology
 from bftkv_tpu.protocol.client import Client
 from bftkv_tpu.protocol.server import Server
 from bftkv_tpu.storage.memkv import MemStorage
+from bftkv_tpu.transport.http import TrHTTP
 from bftkv_tpu.transport.loopback import LoopbackNet, TrLoopback
+
+# Each HTTP cluster gets a disjoint port range so tests never collide.
+_port_block = itertools.count(16001, 100)
 
 
 @dataclass
 class Cluster:
     universe: topology.Universe
-    net: LoopbackNet
+    net: LoopbackNet | None
     servers: list[Server] = field(default_factory=list)  # quorum (a*)
     storage_servers: list[Server] = field(default_factory=list)  # rw*
     clients: list[Client] = field(default_factory=list)
@@ -49,17 +55,37 @@ def start_cluster(
     server_cls=Server,
     client_cls=Client,
     transport_cls=TrLoopback,
+    transport: str = "loop",
 ) -> Cluster:
-    uni = topology.build_universe(
-        n_servers, n_users, n_rw, scheme="loop", bits=bits,
-        unsigned_users=unsigned_users,
-    )
-    net = LoopbackNet()
+    """``transport="loop"`` wires the in-process loopback net;
+    ``transport="http"`` starts every server on a real localhost HTTP
+    port — the reference's tier-3 shape (protocol/test_utils.go:24-82,
+    one process, loopback sockets)."""
+    if transport == "http":
+        http_cls = TrHTTP if transport_cls is TrLoopback else transport_cls
+        if not (isinstance(http_cls, type) and issubclass(http_cls, TrHTTP)):
+            raise ValueError(
+                f"transport='http' needs a TrHTTP subclass, got {transport_cls}"
+            )
+        base = next(_port_block)
+        uni = topology.build_universe(
+            n_servers, n_users, n_rw, scheme="http", bits=bits,
+            base_port=base, rw_base_port=base + 50,
+            unsigned_users=unsigned_users,
+        )
+        net = None
+        make_tr = lambda crypt: http_cls(crypt)
+    else:
+        uni = topology.build_universe(
+            n_servers, n_users, n_rw, scheme="loop", bits=bits,
+            unsigned_users=unsigned_users,
+        )
+        net = LoopbackNet()
+        make_tr = lambda crypt: transport_cls(crypt, net)
     cluster = Cluster(universe=uni, net=net)
     for ident in uni.servers + uni.storage_nodes:
         graph, crypt, qs = topology.make_node(ident, uni.view_of(ident))
-        tr = transport_cls(crypt, net)
-        srv = server_cls(graph, qs, tr, crypt, storage_factory())
+        srv = server_cls(graph, qs, make_tr(crypt), crypt, storage_factory())
         srv.start()
         if ident in uni.servers:
             cluster.servers.append(srv)
@@ -67,6 +93,5 @@ def start_cluster(
             cluster.storage_servers.append(srv)
     for ident in uni.users:
         graph, crypt, qs = topology.make_node(ident, uni.view_of(ident))
-        tr = transport_cls(crypt, net)
-        cluster.clients.append(client_cls(graph, qs, tr, crypt))
+        cluster.clients.append(client_cls(graph, qs, make_tr(crypt), crypt))
     return cluster
