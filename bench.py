@@ -232,6 +232,9 @@ def bench_cluster(
 
     metrics.reset()
     dispatch.install(dispatch.VerifyDispatcher(max_batch=dispatch_batch))
+    dispatch.install_signer(
+        dispatch.SignDispatcher(max_batch=max(dispatch_batch // 2, 64))
+    )
     value = os.urandom(value_size)
     # Warm the protocol path and the device bucket shapes the run can hit
     # (pays XLA compilation outside the timed region). A write burst at n
@@ -248,6 +251,13 @@ def bench_cluster(
     while bucket <= bucket_max:
         if bucket >= d.verifier.host_threshold:
             d.verifier.verify_batch(warm_items[:bucket])
+        bucket *= 2
+    ds = dispatch.get_signer()
+    sign_items = [(m, clients[0].crypt.signer.key) for m, _s, _k in warm_items]
+    bucket = 16
+    while bucket <= ds.max_batch:
+        if bucket >= ds.signer.host_threshold:
+            ds.signer.sign_batch(sign_items[:bucket])
         bucket *= 2
     metrics.reset()
 
@@ -316,9 +326,12 @@ def bench_cluster(
         "dispatch_batch_p50": snap.get("dispatch.batch.p50", 0),
         "verifies_host": snap.get("verify.host", 0),
         "verifies_device": snap.get("verify.device", 0),
+        "signs_host": snap.get("sign.host", 0),
+        "signs_device": snap.get("sign.device", 0),
+        "sign_batch_p50": snap.get("signdispatch.batch.p50", 0),
         "setup_s": round(setup_s, 1),
     }
-    dispatch.uninstall()
+    dispatch.uninstall_all()
     for s in servers:
         s.tr.stop()
     if tmp is not None:

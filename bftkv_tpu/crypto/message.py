@@ -1,22 +1,49 @@
-"""Message security: sign-then-encrypt with nonce echo.
+"""Message security: sign-then-encrypt with nonce echo + session keys.
 
 Capability parity with the reference's transport session layer
-(reference: crypto_pgp.go:418-471): every peer-to-peer payload is signed
-by the sender, encrypted to the recipient set, and carries a nonce the
-responder must echo (replay protection — the reference smuggles the nonce
-through the PGP literal-data filename; here it is a first-class field).
+(reference: crypto_pgp.go:418-471): every peer-to-peer payload is
+confidential, authenticated to the sending identity, and carries a nonce
+the responder must echo (replay protection — the reference smuggles the
+nonce through the PGP literal-data filename; here it is a first-class
+field).
 
-Hybrid scheme: fresh AES-256-GCM content key, wrapped per-recipient with
-RSA-OAEP(SHA-256). The sender's certificate rides inside the signed
-envelope so a recipient that has never seen the sender (the Join flow,
-reference: server.go:64-120) can still authenticate the message and
-decide trust at the protocol layer.
+TPU-framework redesign (not in the reference): the reference pays a PGP
+public-key sign + per-recipient encrypt on *every* message, which
+profiling shows dominates the write path (~4 RSA-2048 private ops per
+request/response pair). Here the asymmetric work happens once per peer
+pair:
 
-Inner (signed) envelope:
-    chunk(plaintext) | chunk(nonce) | chunk(sender_cert)
-Outer:
-    u16 nrecip | nrecip × (u64 recipient_id | chunk(wrapped_key)) |
-    chunk(gcm_nonce | ciphertext(inner | chunk(sig)))
+- **Bootstrap envelope (tag 0x01)** — the first message to a peer uses
+  the full hybrid scheme: fresh AES-256-GCM content key wrapped
+  per-recipient with RSA-OAEP(SHA-256), inner envelope signed by the
+  sender, sender certificate included so a stranger (the Join flow,
+  reference: server.go:64-120) can authenticate it. The signed inner
+  additionally *grants* each recipient a pairwise session key
+  (OAEP-wrapped to that recipient alone, so co-recipients cannot read
+  each other's grants).
+- **Session envelope (tag 0x02)** — subsequent messages wrap a fresh
+  content key per-recipient under the pairwise session key with
+  AES-GCM; no RSA anywhere. Authenticity follows from the session key
+  being known only to the two peers of the RSA-authenticated bootstrap;
+  a role byte in the key-wrap AAD kills reflection. Byzantine
+  *accountability* never rested on this layer — protocol content is
+  signed by certificates (collective signatures) regardless of how the
+  transport session is keyed.
+- A receiver that lost the session (restart, cache eviction) fails with
+  the interned ``ERR_UNKNOWN_SESSION``; the transport fan-out catches it
+  and retries that peer once with a fresh bootstrap (self-healing).
+
+Wire formats:
+    bootstrap: 0x01 | u16 n | n×(u64 rid | chunk(oaep(content_key))) |
+               chunk(gcm_nonce | GCM(content_key, inner | chunk(sig)))
+      inner  = chunk(plaintext) | chunk(nonce) | chunk(sender_cert) |
+               chunk(grants);  grants = n×(u64 rid | chunk(session_id) |
+               chunk(oaep(session_key)))
+    session:   0x02 | u16 n | n×(u64 rid | chunk(session_id) |
+               chunk(kw_nonce | GCM(session_key, content_key,
+               aad=b"kw"+role))) |
+               chunk(gcm_nonce | GCM(content_key, chunk(plaintext) |
+               chunk(nonce)))
 """
 
 from __future__ import annotations
@@ -24,6 +51,8 @@ from __future__ import annotations
 import io
 import os
 import struct
+import threading
+from collections import OrderedDict
 
 from cryptography.hazmat.primitives import hashes
 from cryptography.hazmat.primitives.asymmetric import padding as _padding
@@ -36,6 +65,7 @@ from bftkv_tpu.errors import (
     ERR_DECRYPTION_FAILURE,
     ERR_INVALID_SIGNATURE,
     ERR_INVALID_TRANSPORT_SECURITY_DATA,
+    ERR_UNKNOWN_SESSION,
 )
 from bftkv_tpu.packet import read_chunk, write_chunk
 
@@ -44,6 +74,12 @@ _OAEP = _padding.OAEP(
     algorithm=hashes.SHA256(),
     label=None,
 )
+
+_TAG_BOOTSTRAP = 0x01
+_TAG_SESSION = 0x02
+
+_ROLE_INITIATOR = 0
+_ROLE_RESPONDER = 1
 
 
 def _public(c: certmod.Certificate):
@@ -61,13 +97,65 @@ def _private(key: rsa.PrivateKey):
     ).private_key()
 
 
+class _SessionOut:
+    __slots__ = ("sid", "key", "role")
+
+    def __init__(self, sid: bytes, key: bytes, role: int):
+        self.sid = sid
+        self.key = key
+        self.role = role
+
+
+class _SessionIn:
+    __slots__ = ("key", "peer", "peer_role")
+
+    def __init__(self, key: bytes, peer: certmod.Certificate, peer_role: int):
+        self.key = key
+        self.peer = peer
+        self.peer_role = peer_role
+
+
 class MessageSecurity:
     """Bound to one identity (signing key + cert)."""
+
+    #: Hostile peers can spam bootstraps; both caches are LRU-bounded.
+    _CACHE_MAX = 8192
 
     def __init__(self, key: rsa.PrivateKey, certificate: certmod.Certificate):
         self.key = key
         self.cert = certificate
         self._priv = _private(key)
+        self._lock = threading.Lock()
+        # peer id -> _SessionOut (how I encrypt *to* that peer)
+        self._by_peer: "OrderedDict[int, _SessionOut]" = OrderedDict()
+        # session id -> _SessionIn (how I decrypt *from* its peer)
+        self._by_id: "OrderedDict[bytes, _SessionIn]" = OrderedDict()
+
+    # -- session cache ----------------------------------------------------
+
+    def _lru_put(self, od: OrderedDict, k, v) -> None:
+        od[k] = v
+        od.move_to_end(k)
+        if len(od) > self._CACHE_MAX:
+            od.popitem(last=False)
+
+    def invalidate(self, peer_id: int) -> None:
+        """Drop the outbound session to ``peer_id`` (the transport calls
+        this when the peer reports ERR_UNKNOWN_SESSION)."""
+        with self._lock:
+            self._by_peer.pop(peer_id, None)
+
+    def _sessions_for(self, recipients) -> list[_SessionOut] | None:
+        with self._lock:
+            out = []
+            for r in recipients:
+                s = self._by_peer.get(r.id)
+                if s is None:
+                    return None
+                out.append(s)
+            return out
+
+    # -- encrypt ----------------------------------------------------------
 
     def encrypt(
         self,
@@ -75,10 +163,54 @@ class MessageSecurity:
         plaintext: bytes,
         nonce: bytes,
     ) -> bytes:
+        sessions = self._sessions_for(recipients)
+        if sessions is not None:
+            return self._encrypt_session(recipients, sessions, plaintext, nonce)
+        return self._encrypt_bootstrap(recipients, plaintext, nonce)
+
+    def _encrypt_session(
+        self, recipients, sessions: list[_SessionOut], plaintext, nonce
+    ) -> bytes:
+        inner = io.BytesIO()
+        write_chunk(inner, plaintext)
+        write_chunk(inner, nonce)
+        content_key = os.urandom(32)
+        gcm_nonce = os.urandom(12)
+        ct = AESGCM(content_key).encrypt(gcm_nonce, inner.getvalue(), b"data")
+
+        out = io.BytesIO()
+        out.write(bytes([_TAG_SESSION]))
+        out.write(struct.pack(">H", len(recipients)))
+        for r, s in zip(recipients, sessions):
+            kw_nonce = os.urandom(12)
+            kw = AESGCM(s.key).encrypt(
+                kw_nonce, content_key, b"kw" + bytes([s.role])
+            )
+            out.write(struct.pack(">Q", r.id))
+            write_chunk(out, s.sid)
+            write_chunk(out, kw_nonce + kw)
+        write_chunk(out, gcm_nonce + ct)
+        return out.getvalue()
+
+    def _encrypt_bootstrap(self, recipients, plaintext, nonce) -> bytes:
+        # Fresh pairwise sessions for every recipient of this envelope.
+        grants = io.BytesIO()
+        new_sessions: list[tuple[int, _SessionOut, certmod.Certificate]] = []
+        for r in recipients:
+            sid = os.urandom(16)
+            skey = os.urandom(32)
+            grants.write(struct.pack(">Q", r.id))
+            write_chunk(grants, sid)
+            write_chunk(grants, _public(r).encrypt(skey, _OAEP))
+            new_sessions.append(
+                (r.id, _SessionOut(sid, skey, _ROLE_INITIATOR), r)
+            )
+
         inner = io.BytesIO()
         write_chunk(inner, plaintext)
         write_chunk(inner, nonce)
         write_chunk(inner, self.cert.serialize())
+        write_chunk(inner, grants.getvalue())
         body = inner.getvalue()
         sig = rsa.sign(body, self.key)
         signed = io.BytesIO()
@@ -90,19 +222,97 @@ class MessageSecurity:
         ct = AESGCM(content_key).encrypt(gcm_nonce, signed.getvalue(), None)
 
         out = io.BytesIO()
+        out.write(bytes([_TAG_BOOTSTRAP]))
         out.write(struct.pack(">H", len(recipients)))
         for r in recipients:
             wrapped = _public(r).encrypt(content_key, _OAEP)
             out.write(struct.pack(">Q", r.id))
             write_chunk(out, wrapped)
         write_chunk(out, gcm_nonce + ct)
+
+        # Commit the new outbound sessions only after the envelope is
+        # fully built (no half-granted state on failure), and mirror
+        # them inbound so the peer's session-keyed *responses* decrypt.
+        with self._lock:
+            for rid, s, r in new_sessions:
+                self._lru_put(self._by_peer, rid, s)
+                # Self-addressed sessions (a node dealing a share to
+                # itself, dsa_core) have one instance on both ends:
+                # encrypt and decrypt must agree on the role, so the
+                # inbound mirror keeps the *initiator* role and
+                # _accept_grant skips self-grants.
+                peer_role = (
+                    _ROLE_INITIATOR if rid == self.cert.id else _ROLE_RESPONDER
+                )
+                self._lru_put(
+                    self._by_id, s.sid, _SessionIn(s.key, r, peer_role)
+                )
         return out.getvalue()
+
+    # -- decrypt ----------------------------------------------------------
 
     def decrypt(self, data: bytes) -> tuple[bytes, certmod.Certificate, bytes]:
         """Returns (plaintext, sender_cert, nonce); the caller is
         responsible for deciding whether to trust ``sender_cert``
         (reference: transport decrypt → Server.Handler dispatch,
         http.go:143 → server.go:562)."""
+        if not data:
+            raise ERR_INVALID_TRANSPORT_SECURITY_DATA
+        tag = data[0]
+        if tag == _TAG_BOOTSTRAP:
+            return self._decrypt_bootstrap(data[1:])
+        if tag == _TAG_SESSION:
+            return self._decrypt_session(data[1:])
+        raise ERR_INVALID_TRANSPORT_SECURITY_DATA
+
+    def _decrypt_session(self, data: bytes):
+        r = io.BytesIO(data)
+        hdr = r.read(2)
+        if len(hdr) < 2:
+            raise ERR_INVALID_TRANSPORT_SECURITY_DATA
+        nrecip = struct.unpack(">H", hdr)[0]
+        my = None
+        try:
+            for _ in range(nrecip):
+                ib = r.read(8)
+                if len(ib) < 8:
+                    raise ERR_INVALID_TRANSPORT_SECURITY_DATA
+                rid = struct.unpack(">Q", ib)[0]
+                sid = read_chunk(r)
+                kw = read_chunk(r)
+                if rid == self.cert.id:
+                    my = (sid, kw)
+            blob = read_chunk(r)
+        except Exception:
+            raise ERR_INVALID_TRANSPORT_SECURITY_DATA from None
+        if my is None or blob is None or len(blob) < 12:
+            raise ERR_DECRYPTION_FAILURE
+        sid, kw = my
+        sid = sid or b""
+        with self._lock:
+            sess = self._by_id.get(sid)
+            if sess is not None:
+                self._by_id.move_to_end(sid)
+        if sess is None:
+            raise ERR_UNKNOWN_SESSION
+        if kw is None or len(kw) < 12:
+            raise ERR_DECRYPTION_FAILURE
+        try:
+            content_key = AESGCM(sess.key).decrypt(
+                kw[:12], kw[12:], b"kw" + bytes([sess.peer_role])
+            )
+            inner = AESGCM(content_key).decrypt(blob[:12], blob[12:], b"data")
+        except Exception:
+            raise ERR_DECRYPTION_FAILURE from None
+        sr = io.BytesIO(inner)
+        try:
+            plaintext = read_chunk(sr) or b""
+            nonce = read_chunk(sr) or b""
+        except Exception:
+            raise ERR_INVALID_TRANSPORT_SECURITY_DATA from None
+        return plaintext, sess.peer, nonce
+
+    def _decrypt_bootstrap(self, data: bytes):
         r = io.BytesIO(data)
         hdr = r.read(2)
         if len(hdr) < 2:
@@ -134,6 +344,7 @@ class MessageSecurity:
             plaintext = read_chunk(sr) or b""
             nonce = read_chunk(sr) or b""
             cert_bytes = read_chunk(sr) or b""
+            grant_bytes = read_chunk(sr) or b""
             body_end = sr.tell()
             sig = read_chunk(sr) or b""
         except Exception:
@@ -151,4 +362,46 @@ class MessageSecurity:
             ok = False
         if not ok:
             raise ERR_INVALID_SIGNATURE
+        self._accept_grant(grant_bytes, sender)
         return plaintext, sender, nonce
+
+    def _accept_grant(self, grant_bytes: bytes, sender) -> None:
+        """Install the session granted to *me* (if any). Grants are
+        authenticated: they live inside the RSA-signed inner envelope."""
+        if sender.id == self.cert.id:
+            return  # self-grant: the encrypt-time mirror is authoritative
+        gr = io.BytesIO(grant_bytes)
+        try:
+            while True:
+                ib = gr.read(8)
+                if len(ib) < 8:
+                    return
+                rid = struct.unpack(">Q", ib)[0]
+                sid = read_chunk(gr) or b""
+                wk = read_chunk(gr) or b""
+                if rid != self.cert.id:
+                    continue
+                skey = self._priv.decrypt(wk, _OAEP)
+                with self._lock:
+                    # A session id belongs to the pair that first used
+                    # it: a Byzantine peer must not be able to overwrite
+                    # an honest pair's inbound session by replaying its
+                    # sid (the sid travels in cleartext on fast-path
+                    # envelopes) in a grant of its own.
+                    existing = self._by_id.get(sid)
+                    if existing is not None and existing.peer.id != sender.id:
+                        continue
+                    self._lru_put(
+                        self._by_id,
+                        sid,
+                        _SessionIn(skey, sender, _ROLE_INITIATOR),
+                    )
+                    self._lru_put(
+                        self._by_peer,
+                        sender.id,
+                        _SessionOut(sid, skey, _ROLE_RESPONDER),
+                    )
+        except Exception:
+            # A torn grant only means the fast path stays cold for this
+            # pair; the carried payload was already authenticated.
+            return

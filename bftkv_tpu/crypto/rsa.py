@@ -10,6 +10,7 @@ reference gets from Go's crypto/rsa (crypto/threshold/rsa/rsa.go:345-378).
 from __future__ import annotations
 
 import hashlib
+import threading
 from collections import OrderedDict
 from dataclasses import dataclass
 
@@ -102,6 +103,151 @@ def verify_host(message: bytes, sig: bytes, key: PublicKey) -> bool:
     if s >= key.n:
         return False
     return pow(s, key.e, key.n) == emsa_pkcs1v15_sha256(message, key.size_bytes)
+
+
+class SignerDomain:
+    """Batched PKCS#1 v1.5 signing on device via CRT.
+
+    Each signature is two half-width modexps (mod p and mod q) batched
+    across concurrent requests into one ``ops.rsa.power_batch`` launch —
+    both halves of every signature ride in the *same* batch — plus a
+    cheap host-side CRT recombination.  A 1024-bit modexp on a v5e runs
+    ~7x a single host core at batch 256 and, unlike host ``pow``,
+    releases the GIL, so server handler threads keep flowing.
+
+    Below ``host_threshold`` items the host signs directly (a device
+    launch costs ~100 ms regardless of size; a host CRT sign is ~9 ms).
+    """
+
+    HOST_CROSSOVER = 16
+
+    def __init__(self, host_threshold: int | None = None):
+        if host_threshold is None:
+            import os
+
+            host_threshold = int(
+                os.environ.get("BFTKV_HOST_SIGN_THRESHOLD", self.HOST_CROSSOVER)
+            )
+        self.host_threshold = host_threshold
+        self._doms: "OrderedDict[int, bigint.MontgomeryDomain | None]" = (
+            OrderedDict()
+        )
+        # key.n -> (dp, dq, qinv): one server signs every share with one
+        # key, so these per-key constants must not be recomputed per item.
+        self._crt: "OrderedDict[int, tuple[int, int, int]]" = OrderedDict()
+        self._dom_lock = threading.Lock()
+
+    _CACHE_MAX = 1024  # distinct private keys in one trust domain: few
+
+    def _dom(self, prime: int, nlimbs: int):
+        with self._dom_lock:
+            dom = self._doms.get(prime, False)
+            if dom is not False:
+                self._doms.move_to_end(prime)
+                return dom
+        try:
+            dom = bigint.MontgomeryDomain(prime, nlimbs)
+        except ValueError:
+            dom = None
+        with self._dom_lock:
+            self._doms[prime] = dom
+            if len(self._doms) > self._CACHE_MAX:
+                self._doms.popitem(last=False)
+        return dom
+
+    def _crt_params(self, key: "PrivateKey") -> tuple[int, int, int]:
+        with self._dom_lock:
+            p = self._crt.get(key.n)
+            if p is not None:
+                self._crt.move_to_end(key.n)
+                return p
+        p = (
+            key.d % (key.p - 1),
+            key.d % (key.q - 1),
+            pow(key.q, -1, key.p),
+        )
+        with self._dom_lock:
+            self._crt[key.n] = p
+            if len(self._crt) > self._CACHE_MAX:
+                self._crt.popitem(last=False)
+        return p
+
+    def sign_batch(self, items: list[tuple[bytes, "PrivateKey"]]) -> list[bytes]:
+        """[(message, key)] → [signature bytes], batched on device."""
+        out: list[bytes | None] = [None] * len(items)
+        # Group device-eligible halves by limb width (p and q of one key
+        # always share a width; different key sizes go in separate
+        # launches so shapes stay uniform).
+        by_width: dict[int, list] = {}
+        host_idx: list[int] = []
+        if len(items) < self.host_threshold:
+            host_idx = list(range(len(items)))
+        else:
+            for i, (message, key) in enumerate(items):
+                lp = limb.nlimbs_for_bits(key.p.bit_length())
+                lq = limb.nlimbs_for_bits(key.q.bit_length())
+                w = max(lp, lq)
+                domp = self._dom(key.p, w)
+                domq = self._dom(key.q, w)
+                if domp is None or domq is None:
+                    host_idx.append(i)
+                    continue
+                m = emsa_pkcs1v15_sha256(message, key.size_bytes)
+                dp, dq, _qinv = self._crt_params(key)
+                by_width.setdefault(w, []).append(
+                    (i, key, m, domp, domq, dp, dq)
+                )
+        for i in host_idx:
+            out[i] = sign(items[i][0], items[i][1])
+        from bftkv_tpu.ops import rsa as rsa_ops
+
+        for w, group in by_width.items():
+            rows_base, rows_e, rows_n, rows_np, rows_r2, rows_one = (
+                [], [], [], [], [], []
+            )
+            for _i, key, m, domp, domq, dp, dq in group:
+                for prime, dom, dexp in (
+                    (key.p, domp, dp),
+                    (key.q, domq, dq),
+                ):
+                    rows_base.append(limb.int_to_limbs(m % prime, w))
+                    rows_e.append(limb.int_to_limbs(dexp, w))
+                    rows_n.append(dom.n)
+                    rows_np.append(dom.n_prime)
+                    rows_r2.append(dom.r2)
+                    rows_one.append(dom.one_mont)
+            # Pad to a power-of-two bucket (floor 32) so only a handful
+            # of kernel shapes ever compile.
+            k = len(rows_base)
+            padded = max(32, 1 << (k - 1).bit_length())
+            for _ in range(padded - k):
+                rows_base.append(rows_base[0])
+                rows_e.append(rows_e[0])
+                rows_n.append(rows_n[0])
+                rows_np.append(rows_np[0])
+                rows_r2.append(rows_r2[0])
+                rows_one.append(rows_one[0])
+            res = np.asarray(
+                rsa_ops.power_batch(
+                    np.stack(rows_base),
+                    np.stack(rows_e),
+                    np.stack(rows_n),
+                    np.stack(rows_np),
+                    np.stack(rows_r2),
+                    np.stack(rows_one),
+                )
+            )[:k]
+            vals = limb.limbs_to_ints(res)
+            metrics.incr("sign.device", len(group))
+            for j, (i, key, m, _domp, _domq, _dp, _dq) in enumerate(group):
+                m1, m2 = vals[2 * j], vals[2 * j + 1]
+                qinv = self._crt_params(key)[2]
+                h = (qinv * (m1 - m2)) % key.p
+                s = m2 + h * key.q
+                out[i] = s.to_bytes(key.size_bytes, "big")
+        if host_idx:
+            metrics.incr("sign.host", len(host_idx))
+        return out  # type: ignore[return-value]
 
 
 class VerifierDomain:

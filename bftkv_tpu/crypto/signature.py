@@ -75,7 +75,14 @@ class Signer:
         self.cert = certificate
 
     def issue(self, tbs: bytes, *, include_cert: bool = True) -> SignaturePacket:
-        sig = rsa.sign(tbs, self.key)
+        # Route through the cross-request sign dispatcher when one is
+        # installed: concurrent handlers' share issuance then batches
+        # into shared CRT-modexp launches and stops serializing on the
+        # GIL (host pow does not release it).
+        from bftkv_tpu.ops import dispatch
+
+        d = dispatch.get_signer()
+        sig = d.sign(tbs, self.key) if d is not None else rsa.sign(tbs, self.key)
         return SignaturePacket(
             type=SIGNATURE_TYPE_NATIVE,
             version=1,

@@ -130,6 +130,10 @@ ERR_SHARE_NOT_FOUND = new_error("share not found")
 ERR_INSUFFICIENT_NUMBER_OF_SECRETS = new_error("insufficient number of secrets")
 ERR_CONTINUE = new_error("continue")  # threshold phase loop sentinel
 ERR_DECRYPTION_FAILURE = new_error("decryption failure")
+# Session-keyed transport (this framework's addition, no reference
+# analog): the receiver no longer holds the pairwise session the sender
+# used; the sender re-bootstraps on seeing this.
+ERR_UNKNOWN_SESSION = new_error("unknown transport session")
 
 # Storage errors (reference: storage/storage.go).
 ERR_NOT_FOUND = new_error("not found")

@@ -1,25 +1,32 @@
-"""Cross-request batching dispatcher — the TPU verification sidecar.
+"""Cross-request batching dispatchers — the TPU crypto sidecar.
 
-The reference verifies signatures one at a time inside each request
-handler (crypto_pgp.go:485-500 called from server.go:207,300).  On TPU
-that wastes the device: a single RSA-2048 e=65537 verify is ~17 modmuls
-over 64 limbs — three orders of magnitude below a v5e's appetite.  The
-dispatcher turns per-request verify calls from *concurrent* server
-handlers into shared device launches:
+The reference runs every RSA operation one at a time inside each request
+handler (crypto_pgp.go:485-500 called from server.go:207,300; DetachSign
+at crypto_pgp.go:346-371).  On TPU that wastes the device: a single
+RSA-2048 e=65537 verify is ~17 modmuls over 128 limbs — three orders of
+magnitude below a v5e's appetite — and host ``pow`` holds the GIL, so
+per-handler signing also serializes the whole server.  The dispatchers
+turn per-request crypto calls from *concurrent* threads into shared
+device launches:
 
-- callers submit their (message, sig, key) batches and block on a
-  future;
+- callers submit their item batches and block on a future;
 - a collector thread flushes when ``max_batch`` items are pending or
   ``max_wait`` elapsed since the first pending item (latency floor for
   low load — SURVEY §7 hard part 2);
-- one ``VerifierDomain.verify_batch`` launch serves every caller in the
-  flush; results are scattered back to the futures.
+- one batched kernel launch serves every caller in the flush; results
+  are scattered back to the futures.
+
+Two instances exist: the **verify** dispatcher (collective-signature
+verification, ``VerifierDomain.verify_batch``) and the **sign**
+dispatcher (collective-signature share issuance,
+``SignerDomain.sign_batch`` — batched CRT modexp).  Both fall back to
+host crypto below their crossover batch size.
 
 Deployment stance: replicas are mutually distrusting, so a dispatcher
 serves exactly one replica's trust domain (or an in-process cluster in
 tests/benchmarks, where the host is one trust domain by construction).
 Batch-occupancy and latency are exported through
-:mod:`bftkv_tpu.metrics` as ``dispatch.batch`` / ``dispatch.wait``.
+:mod:`bftkv_tpu.metrics` as ``<name>.batch`` / ``<name>.wait``.
 """
 
 from __future__ import annotations
@@ -31,7 +38,17 @@ import numpy as np
 
 from bftkv_tpu.metrics import registry as metrics
 
-__all__ = ["VerifyDispatcher", "install", "uninstall", "get"]
+__all__ = [
+    "VerifyDispatcher",
+    "SignDispatcher",
+    "install",
+    "uninstall",
+    "get",
+    "install_signer",
+    "uninstall_signer",
+    "get_signer",
+    "uninstall_all",
+]
 
 
 class _Pending:
@@ -44,15 +61,13 @@ class _Pending:
         self.error: Exception | None = None
 
 
-class VerifyDispatcher:
-    """Accumulates verify requests across threads into device batches."""
+class _BatchDispatcher:
+    """Accumulates per-thread requests into shared device batches."""
 
-    def __init__(self, verifier=None, *, max_batch: int = 1024, max_wait: float = 0.002):
-        if verifier is None:
-            from bftkv_tpu.crypto import rsa as rsamod
+    #: metrics prefix; subclasses override.
+    name = "dispatch"
 
-            verifier = rsamod.VerifierDomain()
-        self.verifier = verifier
+    def __init__(self, *, max_batch: int = 1024, max_wait: float = 0.002):
         self.max_batch = max_batch
         self.max_wait = max_wait
         self._lock = threading.Lock()
@@ -62,14 +77,26 @@ class VerifyDispatcher:
         self._running = False
         self._thread: threading.Thread | None = None
 
+    # -- subclass hooks ---------------------------------------------------
+
+    def _run_batch(self, items: list):
+        """One batched launch; returns a sequence aligned with items."""
+        raise NotImplementedError
+
+    def _combine(self, chunks: list):
+        return np.concatenate(chunks)
+
+    def _empty(self):
+        return np.zeros((0,), dtype=bool)
+
     # -- lifecycle --------------------------------------------------------
 
-    def start(self) -> "VerifyDispatcher":
+    def start(self):
         with self._lock:
             if self._running:
                 return self
             self._running = True
-        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread = threading.Thread(target=self._collector, daemon=True)
         self._thread.start()
         return self
 
@@ -83,10 +110,10 @@ class VerifyDispatcher:
 
     # -- caller side ------------------------------------------------------
 
-    def verify(self, items: list) -> np.ndarray:
-        """Blocking batched verify; safe from any thread."""
+    def submit(self, items: list):
+        """Blocking batched call; safe from any thread."""
         if not items:
-            return np.zeros((0,), dtype=bool)
+            return self._empty()
         p = _Pending(items)
         t0 = time.perf_counter()
         with self._cv:
@@ -99,16 +126,16 @@ class VerifyDispatcher:
                 self._queued_items += len(items)
                 self._cv.notify_all()
         if not running:
-            return self.verifier.verify_batch(items)
+            return self._run_batch(items)
         p.event.wait()
-        metrics.observe("dispatch.wait", time.perf_counter() - t0)
+        metrics.observe(f"{self.name}.wait", time.perf_counter() - t0)
         if p.error is not None:
             raise p.error
         return p.result
 
     # -- collector --------------------------------------------------------
 
-    def _run(self) -> None:
+    def _collector(self) -> None:
         while True:
             with self._cv:
                 while self._running and not self._queue:
@@ -131,19 +158,19 @@ class VerifyDispatcher:
 
     def _flush(self, batch: list[_Pending]) -> None:
         flat = [it for p in batch for it in p.items]
-        metrics.observe("dispatch.batch", len(flat))
-        metrics.incr("dispatch.flushes")
-        metrics.incr("dispatch.verifies", len(flat))
+        metrics.observe(f"{self.name}.batch", len(flat))
+        metrics.incr(f"{self.name}.flushes")
+        metrics.incr(f"{self.name}.items", len(flat))
         try:
             if len(flat) <= self.max_batch:
-                ok = self.verifier.verify_batch(flat)
+                out = self._run_batch(flat)
             else:
                 # A burst can out-run the collector and drain as one
                 # oversized queue; chunk the device launches so padded
                 # batch shapes stay bounded by max_batch.
-                ok = np.concatenate(
+                out = self._combine(
                     [
-                        self.verifier.verify_batch(flat[i : i + self.max_batch])
+                        self._run_batch(flat[i : i + self.max_batch])
                         for i in range(0, len(flat), self.max_batch)
                     ]
                 )
@@ -154,18 +181,74 @@ class VerifyDispatcher:
             return
         off = 0
         for p in batch:
-            p.result = ok[off : off + len(p.items)]
+            p.result = out[off : off + len(p.items)]
             off += len(p.items)
             p.event.set()
 
 
+class VerifyDispatcher(_BatchDispatcher):
+    """Batched signature verification (items: (message, sig, PublicKey))."""
+
+    name = "dispatch"  # historical metric names kept stable
+
+    def __init__(self, verifier=None, *, max_batch: int = 1024, max_wait: float = 0.002):
+        super().__init__(max_batch=max_batch, max_wait=max_wait)
+        if verifier is None:
+            from bftkv_tpu.crypto import rsa as rsamod
+
+            verifier = rsamod.VerifierDomain()
+        self.verifier = verifier
+
+    def _run_batch(self, items: list):
+        return self.verifier.verify_batch(items)
+
+    def verify(self, items: list) -> np.ndarray:
+        out = self.submit(items)
+        metrics.incr("dispatch.verifies", len(items))
+        return out
+
+
+class SignDispatcher(_BatchDispatcher):
+    """Batched PKCS#1 v1.5 signing (items: (message, PrivateKey)).
+
+    The server-side hot loop this absorbs is collective-signature share
+    issuance — one RSA private op per server per sign request
+    (reference: crypto_pgp.go:346-371 via server.go:264) — which
+    otherwise serializes the whole process behind the GIL.
+    """
+
+    name = "signdispatch"
+
+    def __init__(self, signer=None, *, max_batch: int = 1024, max_wait: float = 0.002):
+        super().__init__(max_batch=max_batch, max_wait=max_wait)
+        if signer is None:
+            from bftkv_tpu.crypto import rsa as rsamod
+
+            signer = rsamod.SignerDomain()
+        self.signer = signer
+
+    def _run_batch(self, items: list):
+        return self.signer.sign_batch(items)
+
+    def _combine(self, chunks: list):
+        return [sig for chunk in chunks for sig in chunk]
+
+    def _empty(self):
+        return []
+
+    def sign(self, message: bytes, key) -> bytes:
+        return self.submit([(message, key)])[0]
+
+
 _global: VerifyDispatcher | None = None
+_global_signer: SignDispatcher | None = None
 _global_lock = threading.Lock()
 
 
 def install(dispatcher: VerifyDispatcher | None = None) -> VerifyDispatcher:
-    """Install (and start) the process-wide dispatcher; verification
-    call sites (``CollectiveSignature.verify``) route through it."""
+    """Install (and start) the process-wide verify dispatcher;
+    verification call sites (``CollectiveSignature.verify``) route
+    through it."""
     global _global
     with _global_lock:
         if _global is not None:
@@ -184,3 +267,31 @@ def uninstall() -> None:
 
 def get() -> VerifyDispatcher | None:
     return _global
+
+
+def install_signer(dispatcher: SignDispatcher | None = None) -> SignDispatcher:
+    """Install (and start) the process-wide sign dispatcher; signing
+    call sites (``Signer.issue``) route through it."""
+    global _global_signer
+    with _global_lock:
+        if _global_signer is not None:
+            _global_signer.stop()
+        _global_signer = (dispatcher or SignDispatcher()).start()
+        return _global_signer
+
+
+def uninstall_signer() -> None:
+    global _global_signer
+    with _global_lock:
+        if _global_signer is not None:
+            _global_signer.stop()
+            _global_signer = None
+
+
+def get_signer() -> SignDispatcher | None:
+    return _global_signer
+
+
+def uninstall_all() -> None:
+    uninstall()
+    uninstall_signer()

@@ -94,6 +94,11 @@ class NativeStorage:
 
     def read(self, variable: bytes, t: int = 0) -> bytes:
         with self._lock:
+            # Protocol threads (read-repair, late sign persists) can
+            # outlive a close(); a NULL handle into the C ABI would be a
+            # use-after-free — fail as storage I/O instead.
+            if not self._handle:
+                raise ERR_STORAGE_IO
             t_out = ctypes.c_uint64(0)
             n = self._lib.kv_read(
                 self._handle, variable, len(variable), t, None, ctypes.byref(t_out)
@@ -116,6 +121,8 @@ class NativeStorage:
         """All stored version timestamps, descending (storage contract —
         the server read path's scan past in-progress sign records)."""
         with self._lock:
+            if not self._handle:
+                return []
             cap = 64
             while True:
                 buf = (ctypes.c_uint64 * cap)()
@@ -130,6 +137,8 @@ class NativeStorage:
 
     def write(self, variable: bytes, t: int, value: bytes) -> None:
         with self._lock:
+            if not self._handle:
+                raise ERR_STORAGE_IO
             rc = self._lib.kv_write(
                 self._handle, variable, len(variable), t, value, len(value)
             )

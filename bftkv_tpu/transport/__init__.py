@@ -28,7 +28,7 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Protocol
 
-from bftkv_tpu.errors import new_error
+from bftkv_tpu.errors import ERR_UNKNOWN_SESSION, new_error
 
 __all__ = [
     "JOIN",
@@ -153,26 +153,49 @@ def multicast(
     ch: "queue.Queue[MulticastResponse]" = queue.Queue()
     cipher = None
     nonce = None
+    payload = None
     launched = 0
     for i, peer in enumerate(peers):
         if i < len(mdata):
             nonce = tr.generate_random()
+            payload = mdata[i] or b""
             try:
                 recipients = peers[i : i + len(peers) - len(mdata) + 1]
-                cipher = tr.encrypt(recipients, mdata[i] or b"", nonce)
+                cipher = tr.encrypt(recipients, payload, nonce)
             except Exception as e:
                 ch.put(MulticastResponse(peer, None, e))
                 launched += 1
                 continue
 
-        def work(peer=peer, cipher=cipher, nonce=nonce):
+        def work(peer=peer, cipher=cipher, nonce=nonce, payload=payload):
             addr = getattr(peer, "address", "")
             if not addr:
                 ch.put(MulticastResponse(peer, None, ERR_NO_ADDRESS()))
                 return
             try:
-                res = tr.post(addr + PREFIX + name, cipher)
-                plain, _sender, echoed = tr.decrypt(res)
+                try:
+                    res = tr.post(addr + PREFIX + name, cipher)
+                    plain, _sender, echoed = tr.decrypt(res)
+                except ERR_UNKNOWN_SESSION:
+                    # One side of the pairwise transport session is gone
+                    # (peer restart or cache eviction on either end):
+                    # drop it and retry once with a fresh bootstrap
+                    # envelope for this peer alone.
+                    sec = getattr(tr, "security", None)
+                    if sec is None:
+                        raise
+                    sec.message.invalidate(peer.id)
+                    nonce2 = tr.generate_random()
+                    cipher2 = tr.encrypt([peer], payload, nonce2)
+                    res = tr.post(addr + PREFIX + name, cipher2)
+                    plain, _sender, echoed = tr.decrypt(res)
+                    if echoed != nonce2:
+                        ch.put(
+                            MulticastResponse(peer, None, ERR_NONCE_MISMATCH())
+                        )
+                        return
+                    ch.put(MulticastResponse(peer, plain, None))
+                    return
                 if echoed != nonce:
                     ch.put(MulticastResponse(peer, None, ERR_NONCE_MISMATCH()))
                     return

@@ -91,6 +91,7 @@ void kv_close(Store* s) {
 // Returns 0 on success.
 int kv_write(Store* s, const uint8_t* var, uint32_t varlen, uint64_t t,
              const uint8_t* val, uint64_t vallen) {
+  if (!s) return -1;  // defense against use-after-close via the ctypes seam
   std::lock_guard<std::mutex> lock(s->mu);
   if (fseek(s->log, (long)s->tail, SEEK_SET) != 0) return -1;
   uint8_t hdr[kHeader];
@@ -114,6 +115,7 @@ int kv_write(Store* s, const uint8_t* var, uint32_t varlen, uint64_t t,
 // (mirrors the leveldb key-range walk, leveldb.go:30-46).
 int64_t kv_versions(Store* s, const uint8_t* var, uint32_t varlen,
                     uint64_t* out, uint64_t cap) {
+  if (!s) return -1;
   std::lock_guard<std::mutex> lock(s->mu);
   auto it = s->index.find(std::string((const char*)var, varlen));
   if (it == s->index.end()) return -1;
@@ -132,6 +134,7 @@ int64_t kv_versions(Store* s, const uint8_t* var, uint32_t varlen,
 // resolved timestamp so the pair of calls is consistent).
 int64_t kv_read(Store* s, const uint8_t* var, uint32_t varlen, uint64_t t,
                 uint8_t* out, uint64_t* t_out) {
+  if (!s) return -2;
   std::lock_guard<std::mutex> lock(s->mu);
   auto it = s->index.find(std::string((const char*)var, varlen));
   if (it == s->index.end() || it->second.empty()) return -1;

@@ -75,6 +75,50 @@ def test_verify_batch_empty():
     assert rsa.VerifierDomain().verify_batch([]).shape == (0,)
 
 
+def test_sign_batch_device_matches_host(keys):
+    """Batched CRT signing on device is bit-identical to host signing
+    (PKCS#1 v1.5 is deterministic), across mixed key sizes."""
+    dom = rsa.SignerDomain(host_threshold=0)
+    big = rsa.generate(2048)
+    items = [(f"m{i}".encode(), keys[i % len(keys)]) for i in range(5)]
+    items.append((b"wide", big))
+    sigs = dom.sign_batch(items)
+    for (m, k), s in zip(items, sigs):
+        assert s == rsa.sign(m, k)
+        assert rsa.verify_host(m, s, k.public)
+
+
+def test_sign_batch_host_crossover(keys):
+    dom = rsa.SignerDomain(host_threshold=64)
+    items = [(b"a", keys[0]), (b"b", keys[1])]
+    assert dom.sign_batch(items) == [rsa.sign(b"a", keys[0]), rsa.sign(b"b", keys[1])]
+
+
+def test_sign_dispatcher_end_to_end(keys):
+    from bftkv_tpu.ops import dispatch
+
+    d = dispatch.SignDispatcher(
+        rsa.SignerDomain(host_threshold=0), max_batch=64, max_wait=0.005
+    ).start()
+    try:
+        import threading
+
+        out: dict = {}
+
+        def go(i):
+            out[i] = d.sign(b"msg-%d" % i, keys[i % len(keys)])
+
+        ts = [threading.Thread(target=go, args=(i,)) for i in range(8)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        for i in range(8):
+            assert rsa.verify_host(b"msg-%d" % i, out[i], keys[i % len(keys)].public)
+    finally:
+        d.stop()
+
+
 def test_verify_batch_host_crossover(keys):
     """Small batches route to the host oracle (device launches only pay
     off past a few hundred items); results are identical either way."""
