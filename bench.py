@@ -157,6 +157,52 @@ def bench_kernel_modexp(batch: int = 256) -> dict:
     }
 
 
+def bench_kernel_ec(batches=(64, 256)) -> dict:
+    """Batched P-256 scalar-mults/sec vs the host oracle (threshold-ECDSA
+    hot loop, reference: crypto/threshold/ecdsa/ecdsa.go:31-59)."""
+    import secrets
+
+    import jax
+
+    from bftkv_tpu.crypto.ec import P256
+    from bftkv_tpu.ops import ec as ec_ops
+
+    d = ec_ops.p256()
+    out: dict = {"batch": {}}
+    bmax = max(batches)
+    pts = [P256.scalar_base_mult(i + 1) for i in range(min(16, bmax))]
+    pts = (pts * (bmax // len(pts) + 1))[:bmax]
+    ks = [secrets.randbelow(P256.n) for _ in range(bmax)]
+    X, Y, Z = d.encode_points(pts)
+    K = d.encode_scalars(ks)
+    for b in sorted(batches):
+        args = [jax.device_put(a[:b]) for a in (X, Y, Z, K)]
+        t0 = time.perf_counter()
+        jax.block_until_ready(ec_ops.scalar_mult_jac(*args))
+        compile_s = time.perf_counter() - t0
+        iters, elapsed = 0, 0.0
+        t0 = time.perf_counter()
+        while elapsed < (0.5 if FAST else 2.0) or iters < 2:
+            jax.block_until_ready(ec_ops.scalar_mult_jac(*args))
+            iters += 1
+            elapsed = time.perf_counter() - t0
+        out["batch"][str(b)] = {
+            "scalar_mults_per_sec": round(b * iters / elapsed, 1),
+            "first_call_s": round(compile_s, 2),
+        }
+    # Host oracle baseline + correctness spot check.
+    got = ec_ops.scalar_mult_hosts(pts[:8], ks[:8])
+    t0 = time.perf_counter()
+    want = [P256.scalar_mult(p, k) for p, k in zip(pts[:8], ks[:8])]
+    host_rate = 8 / (time.perf_counter() - t0)
+    assert got == want, "EC kernel/oracle mismatch"
+    out["host_scalar_mults_per_sec"] = round(host_rate, 1)
+    best = max(v["scalar_mults_per_sec"] for v in out["batch"].values())
+    out["best_scalar_mults_per_sec"] = best
+    out["speedup_vs_host"] = round(best / host_rate, 2)
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Cluster benchmarks (the TestManyWrites/TestManyReads analog)
 # ---------------------------------------------------------------------------
@@ -401,9 +447,9 @@ def main() -> None:
 
     configs = _env_list(
         "BENCH_CONFIGS",
-        "kernel,modexp,c4,c16,tally"
+        "kernel,modexp,ec,c4,c16,tally"
         if FAST
-        else "kernel,modexp,c4,c4http,c16,c64,tally",
+        else "kernel,modexp,ec,c4,c4http,c16,c64,tally",
     )
     batches = [int(b) for b in _env_list("BENCH_KERNEL_BATCHES", "256,1024,4096")]
     writers = int(os.environ.get("BENCH_WRITERS", "4" if FAST else "8"))
@@ -413,6 +459,8 @@ def main() -> None:
         extra["verify_kernel"] = bench_kernel_verify(batches)
     if "modexp" in configs:
         extra["modexp_kernel"] = bench_kernel_modexp(64 if FAST else 256)
+    if "ec" in configs:
+        extra["ec_kernel"] = bench_kernel_ec((64,) if FAST else (64, 256))
 
     headline = None
     if "c4" in configs:

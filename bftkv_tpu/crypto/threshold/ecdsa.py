@@ -37,21 +37,49 @@ class _ECDSAGroupOps:
     def __init__(self, curve: ec.Curve):
         self.curve = curve
 
+    def _device_capable(self) -> bool:
+        return self.curve.name == "P-256" or (
+            self.curve.p == ec.P256.p and self.curve.n == ec.P256.n
+        )
+
     def calculate_partial_r(self, ai: int) -> bytes:
-        return ec.marshal(self.curve, self.curve.scalar_base_mult(ai))
+        """a_i·G — on the batched device kernel for P-256
+        (reference: ecdsa.go:31-41; TPU path: bftkv_tpu.ops.ec)."""
+        if self._device_capable():
+            from bftkv_tpu.ops import ec as ec_ops
+
+            # Use *this* curve's generator: parse_params can produce a
+            # P-256-field curve with a different base point.
+            pt = ec_ops.scalar_mult_hosts(
+                [(self.curve.gx, self.curve.gy)], [ai]
+            )[0]
+        else:
+            pt = self.curve.scalar_base_mult(ai)
+        return ec.marshal(self.curve, pt)
 
     def calculate_r(self, rs: list[PartialR]) -> int:
+        """R = (Σ v_i λ_i)^{-1} · Σ λ_i·R_i; the λ_i·R_i scalar mults and
+        the final inversion mult ride device launches for P-256
+        (reference: ecdsa.go:43-59)."""
         xs = [pr.x for pr in rs]
         n = self.curve.n
-        acc = None
-        v = 0
-        for pr in rs:
-            lam = sss.lagrange(pr.x, xs, n)
-            pt = ec.unmarshal(self.curve, pr.ri)
-            acc = self.curve.add(acc, self.curve.scalar_mult(pt, lam))
-            v = (v + pr.vi * lam) % n
+        pts = [ec.unmarshal(self.curve, pr.ri) for pr in rs]
+        lams = [sss.lagrange(pr.x, xs, n) for pr in rs]
+        v = sum(pr.vi * lam for pr, lam in zip(rs, lams)) % n
         v_inv = pow(v, -1, n)
-        final = self.curve.scalar_mult(acc, v_inv)
+        if self._device_capable():
+            from bftkv_tpu.ops import ec as ec_ops
+
+            # v_inv·Σλ_i·R_i == Σ(v_inv·λ_i)·R_i — fold the inversion
+            # into the coefficients so everything is one launch.
+            final = ec_ops.linear_combine_hosts(
+                pts, [(v_inv * lam) % n for lam in lams]
+            )
+        else:
+            acc = None
+            for pt, lam in zip(pts, lams):
+                acc = self.curve.add(acc, self.curve.scalar_mult(pt, lam))
+            final = self.curve.scalar_mult(acc, v_inv)
         return final[0] % n
 
     def subgroup_order(self) -> int:
