@@ -116,6 +116,17 @@ class API:
             value = dataenc.encrypt(value, key)
         self.client.write(variable, value, proof)
 
+    def write_once(
+        self, variable: bytes, value: bytes, password: str = ""
+    ) -> None:
+        """Immutable write (t = 2^64-1), with the same password
+        protection as :meth:`write`."""
+        proof = None
+        if password:
+            proof, key = self.client.authenticate(variable, password.encode())
+            value = dataenc.encrypt(value, key)
+        self.client.write_once(variable, value, proof)
+
     def read(self, variable: bytes, password: str = "") -> bytes | None:
         proof = None
         key = None

@@ -1,0 +1,11 @@
+"""Operator-facing commands.
+
+- ``python -m bftkv_tpu.cmd.genkeys`` — key/topology generator
+  (replaces the reference's GnuPG scripts, scripts/setup.sh).
+- ``python -m bftkv_tpu.cmd.bftkv`` — server daemon with a client-facing
+  HTTP API (reference: cmd/bftkv/main.go).
+- ``python -m bftkv_tpu.cmd.bftrw`` — user CLI: register / read / write
+  / ca / sign / kms / getkey (reference: cmd/bftrw/bftrw.go).
+- ``python -m bftkv_tpu.cmd.run_cluster`` — spawn one daemon process per
+  home directory (reference: scripts/run.sh).
+"""

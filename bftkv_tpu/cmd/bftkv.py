@@ -1,0 +1,252 @@
+"""``bftkv`` server daemon.
+
+Capability parity with the reference daemon (cmd/bftkv/main.go:36-267):
+load a home directory (pubring/secring), build
+graph/quorum/transport/storage, start the protocol server on the
+certificate's address, and optionally expose a client-facing HTTP API:
+
+    GET/POST /read/<var>      value bytes (404 when absent)
+    POST     /write/<var>     body = value
+    POST     /writeonce/<var> body = value (t = 2^64-1, immutable)
+    GET      /joining         re-crawl the trust graph
+    GET      /leaving
+    GET      /show            trust-graph dump (text)
+    GET      /metrics         JSON metrics snapshot (no reference
+                              analog; stands in for the visualizer feed)
+
+The revocation list is loaded at startup and persisted on shutdown —
+the reference parses it but leaves persistence disabled
+(main.go:119-121,170-183); here it round-trips.
+
+    python -m bftkv_tpu.cmd.bftkv --home /tmp/keys/a01 --db /tmp/db/a01 \
+        --api 127.0.0.1:7001 [--storage native] [--dispatch]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from bftkv_tpu.errors import ERR_NOT_FOUND, Error
+
+MAX_UINT64 = (1 << 64) - 1
+
+
+def build_server(args):
+    from bftkv_tpu import topology
+    from bftkv_tpu.protocol.server import Server
+    from bftkv_tpu.transport.http import TrHTTP
+
+    graph, crypt, qs = topology.load_home(args.home)
+
+    if args.storage == "plain":
+        from bftkv_tpu.storage.plain import PlainStorage
+
+        storage = PlainStorage(args.db)
+    elif args.storage == "native":
+        from bftkv_tpu.storage.native import NativeStorage
+
+        storage = NativeStorage(args.db)
+    else:
+        from bftkv_tpu.storage.memkv import MemStorage
+
+        storage = MemStorage()
+
+    # Revocation list (reference: main.go:119-121 parses; persistence
+    # re-enabled here).
+    try:
+        with open(args.revlist, "rb") as f:
+            from bftkv_tpu.crypto import cert as certmod
+
+            revoked = certmod.parse(f.read())
+            graph.revoke_nodes(revoked)
+            if revoked:
+                print(f"revoked {len(revoked)} node(s) from {args.revlist}")
+    except OSError:
+        pass
+
+    tr = TrHTTP(crypt)
+    server = Server(graph, qs, tr, crypt, storage)
+    return server, graph, crypt, qs, tr
+
+
+class _ApiHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *a):
+        pass
+
+    def _reply(self, code: int, body: bytes, ctype="application/octet-stream"):
+        self.send_response(code)
+        self.send_header("content-type", ctype)
+        self.send_header("content-length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _var(self, prefix: str) -> bytes:
+        rest = self.path[len(prefix):]
+        return urllib.parse.unquote(rest).encode()
+
+    _MUTATING = ("/write/", "/writeonce/", "/joining", "/leaving")
+
+    def _handle(self):
+        svc = self.server.svc
+        path = self.path
+        if self.command == "GET" and path.startswith(self._MUTATING):
+            # Idempotent GETs (prefetchers, probes) must not mutate
+            # quorum state.
+            self._reply(405, b"method not allowed\n", "text/plain")
+            return
+        try:
+            if path.startswith("/read/"):
+                value = svc.client.read(self._var("/read/"))
+                if value is None:
+                    self._reply(404, b"not found\n", "text/plain")
+                else:
+                    self._reply(200, value)
+            elif path.startswith("/write/") or path.startswith("/writeonce/"):
+                length = int(self.headers.get("content-length", "0"))
+                value = self.rfile.read(length)
+                if path.startswith("/write/"):
+                    svc.client.write(self._var("/write/"), value)
+                else:
+                    svc.client.write_once(self._var("/writeonce/"), value)
+                self._reply(200, b"ok\n", "text/plain")
+            elif path == "/joining":
+                svc.client.joining()
+                self._reply(200, b"joined\n", "text/plain")
+            elif path == "/leaving":
+                svc.client.leaving()
+                self._reply(200, b"left\n", "text/plain")
+            elif path == "/show":
+                self._reply(200, svc.show().encode(), "text/plain")
+            elif path == "/metrics":
+                from bftkv_tpu.metrics import registry as metrics
+
+                body = json.dumps(metrics.snapshot(), sort_keys=True).encode()
+                self._reply(200, body, "application/json")
+            else:
+                self._reply(404, b"unknown endpoint\n", "text/plain")
+        except Error as e:
+            code = 404 if type(e) is ERR_NOT_FOUND else 500
+            self._reply(code, (e.message + "\n").encode(), "text/plain")
+        except Exception as e:  # operator surface: never kill the daemon
+            self._reply(500, (str(e) + "\n").encode(), "text/plain")
+
+    do_GET = _handle
+    do_POST = _handle
+
+
+class _ApiService:
+    """The daemon's own protocol client + graph introspection
+    (reference: apiService, main.go:209-267)."""
+
+    def __init__(self, client, graph):
+        self.client = client
+        self.graph = graph
+
+    def show(self) -> str:
+        g = self.graph
+        lines = [f"self: {g.name} id={g.id:016x} addr={g.address} uid={g.uid}"]
+        for peer in g.get_peers():
+            lines.append(
+                f"peer: {peer.name} id={peer.id:016x} addr={peer.address} "
+                f"active={peer.active} "
+                f"signers={[f'{s:016x}' for s in peer.signers()]}"
+            )
+        revoked = g.serialize_revoked()
+        lines.append(f"revoked: {len(revoked)} bytes")
+        return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description="bftkv server daemon")
+    ap.add_argument("--home", required=True, help="home dir (pubring/secring)")
+    ap.add_argument("--db", default="", help="storage path (dir or log file)")
+    ap.add_argument("--storage", choices=["plain", "native", "mem"],
+                    default="plain")
+    ap.add_argument("--api", default="", help="client API listen addr host:port")
+    ap.add_argument("--client-home", default="",
+                    help="home dir whose identity performs client-API "
+                         "reads/writes (a *user* identity: a server's own "
+                         "identity under-collects collective signatures — "
+                         "its AUTH|PEER quorum excludes itself, so its "
+                         "sufficiency target is below what verifying "
+                         "replicas require on the full clique; the "
+                         "reference has the same property)")
+    ap.add_argument("--revlist", default="", help="revocation list file")
+    ap.add_argument("--join", action="store_true",
+                    help="crawl the trust graph at startup")
+    ap.add_argument("--dispatch", action="store_true",
+                    help="install the TPU verify/sign dispatchers "
+                         "(one replica process per accelerator)")
+    args = ap.parse_args(argv)
+    if not args.db and args.storage != "mem":
+        args.db = args.home.rstrip("/") + ".db"
+    if not args.revlist:
+        args.revlist = args.home.rstrip("/") + ".rev"
+
+    server, graph, crypt, qs, tr = build_server(args)
+
+    if args.dispatch:
+        from bftkv_tpu.ops import dispatch
+
+        dispatch.install()
+        dispatch.install_signer()
+
+    server.start()
+    print(f"bftkv: serving {graph.name} @ {graph.address}", flush=True)
+
+    from bftkv_tpu.protocol.client import Client
+
+    if args.client_home:
+        from bftkv_tpu import topology
+        from bftkv_tpu.transport.http import TrHTTP
+
+        cgraph, ccrypt, cqs = topology.load_home(args.client_home)
+        client = Client(cgraph, cqs, TrHTTP(ccrypt), ccrypt)
+    else:
+        client = Client(graph, qs, tr, crypt)
+    if args.join:
+        client.joining()
+
+    api_httpd = None
+    if args.api:
+        host, _, port = args.api.rpartition(":")
+        api_httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
+                                        _ApiHandler)
+        api_httpd.daemon_threads = True
+        api_httpd.svc = _ApiService(client, graph)
+        threading.Thread(target=api_httpd.serve_forever, daemon=True).start()
+        print(f"bftkv: client API @ {args.api}", flush=True)
+
+    stop = threading.Event()
+
+    def shutdown(signum, frame):
+        stop.set()
+
+    signal.signal(signal.SIGTERM, shutdown)
+    signal.signal(signal.SIGINT, shutdown)
+    stop.wait()
+
+    # Persist the revocation list (re-enabling main.go:170-183).
+    rl = graph.serialize_revoked()
+    if rl:
+        with open(args.revlist, "wb") as f:
+            f.write(rl)
+    if api_httpd is not None:
+        api_httpd.shutdown()
+    server.stop()
+    if hasattr(server.storage, "close"):
+        server.storage.close()
+    print("bftkv: stopped", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,170 @@
+"""``bftrw`` user CLI — register / read / write / ca / sign / kms / getkey.
+
+Capability parity with the reference user tool
+(cmd/bftrw/bftrw.go:60-165,188-316):
+
+    bftrw --home /tmp/keys/u01 register --peers /tmp/keys/a01 ... --password pw
+    bftrw --home /tmp/keys/u01 write  x [value | -]   [--password pw]
+    bftrw --home /tmp/keys/u01 writeonce x [value | -]
+    bftrw --home /tmp/keys/u01 read   x               [--password pw]
+    bftrw --home /tmp/keys/u01 ca     <caname> --key ca.pkcs8 [--threshold-algo rsa]
+    bftrw --home /tmp/keys/u01 sign   <caname> --in tbs.bin --algo rsa --hash sha256
+    bftrw --home /tmp/keys/u01 kms    <caname> --password pw   # random key, stored wrapped
+    bftrw --home /tmp/keys/u01 getkey <caname> <name> --password pw
+
+``ca`` deals a private key to the quorum as threshold shares;
+``sign`` threshold-signs arbitrary TBS bytes with it (the reference's
+X.509-specific plumbing is left to the caller — the signature bytes are
+standard PKCS#1 v1.5 / DSA / ECDSA).  ``kms`` generates a random
+256-bit key, stores it under a random name password-protected, and
+prints the name (reference: bftrw.go:272-316).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _algo(name: str):
+    from bftkv_tpu.crypto.threshold import ThresholdAlgo
+
+    return {
+        "rsa": ThresholdAlgo.RSA,
+        "dsa": ThresholdAlgo.DSA,
+        "ecdsa": ThresholdAlgo.ECDSA,
+    }[name]
+
+
+def _load_ca_key(path: str):
+    """PKCS#8 (or traditional PEM) private key → framework key object
+    (reference: bftrw.go:217-243 readPKCS8)."""
+    from cryptography.hazmat.primitives import serialization
+
+    with open(path, "rb") as f:
+        data = f.read()
+    load = (
+        serialization.load_pem_private_key
+        if b"-----BEGIN" in data
+        else serialization.load_der_private_key
+    )
+    key = load(data, password=None)
+    from cryptography.hazmat.primitives.asymmetric import ec as cec
+    from cryptography.hazmat.primitives.asymmetric import rsa as crsa
+
+    if isinstance(key, crsa.RSAPrivateKey):
+        from bftkv_tpu.crypto import rsa
+
+        pn = key.private_numbers()
+        return rsa.PrivateKey(
+            n=pn.public_numbers.n, e=pn.public_numbers.e, d=pn.d, p=pn.p, q=pn.q
+        )
+    if isinstance(key, cec.EllipticCurvePrivateKey):
+        from bftkv_tpu.crypto import ec as ecmod
+        from bftkv_tpu.crypto.threshold.ecdsa import ECDSAPrivateKey
+
+        if key.curve.name != "secp256r1":
+            raise SystemExit(f"unsupported curve {key.curve.name}")
+        return ECDSAPrivateKey(ecmod.P256, key.private_numbers().private_value)
+    raise SystemExit(f"unsupported CA key type for {path}")
+
+
+def _value_arg(v: str | None) -> bytes:
+    if v is None or v == "-":
+        return sys.stdin.buffer.read()
+    return v.encode()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description="bftkv user tool")
+    ap.add_argument("--home", required=True)
+    ap.add_argument("--no-join", action="store_true",
+                    help="skip the joining crawl (offline commands)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("register")
+    p.add_argument("--peers", nargs="+", required=True,
+                   help="server home dirs to trust")
+    p.add_argument("--password", required=True)
+
+    for name in ("read", "write", "writeonce"):
+        p = sub.add_parser(name)
+        p.add_argument("variable")
+        if name != "read":
+            p.add_argument("value", nargs="?")
+        p.add_argument("--password", default="")
+
+    p = sub.add_parser("ca")
+    p.add_argument("caname")
+    p.add_argument("--key", required=True, help="PKCS#8 private key file")
+
+    p = sub.add_parser("sign")
+    p.add_argument("caname")
+    p.add_argument("--in", dest="infile", required=True)
+    p.add_argument("--algo", choices=["rsa", "dsa", "ecdsa"], default="rsa")
+    p.add_argument("--hash", dest="hash_name", default="sha256")
+    p.add_argument("--out", default="", help="signature output (default stdout)")
+
+    p = sub.add_parser("kms")
+    p.add_argument("caname")
+    p.add_argument("--password", required=True)
+
+    p = sub.add_parser("getkey")
+    p.add_argument("caname")
+    p.add_argument("name")
+    p.add_argument("--password", required=True)
+
+    args = ap.parse_args(argv)
+
+    from bftkv_tpu import api as apimod
+
+    a = apimod.open_client(args.home, join=not args.no_join)
+
+    if args.cmd == "register":
+        a.register(args.peers, args.password)
+        print(f"registered uid={a.uid}")
+    elif args.cmd == "read":
+        value = a.read(args.variable.encode(), args.password)
+        if value is None:
+            print("not found", file=sys.stderr)
+            return 1
+        sys.stdout.buffer.write(value)
+    elif args.cmd in ("write", "writeonce"):
+        value = _value_arg(args.value)
+        if args.cmd == "write":
+            a.write(args.variable.encode(), value, args.password)
+        else:
+            a.write_once(args.variable.encode(), value, args.password)
+        print("ok", file=sys.stderr)
+    elif args.cmd == "ca":
+        key = _load_ca_key(args.key)
+        a.distribute(args.caname, key)
+        print(f"ca {args.caname}: key distributed")
+    elif args.cmd == "sign":
+        with open(args.infile, "rb") as f:
+            tbs = f.read()
+        sig = a.sign(args.caname, tbs, _algo(args.algo), args.hash_name)
+        if args.out:
+            with open(args.out, "wb") as f:
+                f.write(sig)
+        else:
+            sys.stdout.buffer.write(sig)
+    elif args.cmd == "kms":
+        # Random name + random key, stored password-protected
+        # (reference: bftrw.go:272-316).
+        name = os.urandom(8).hex()
+        key = os.urandom(32)
+        a.write((args.caname + "/" + name).encode(), key, args.password)
+        print(name)
+    elif args.cmd == "getkey":
+        value = a.read((args.caname + "/" + args.name).encode(), args.password)
+        if value is None:
+            print("not found", file=sys.stderr)
+            return 1
+        sys.stdout.buffer.write(value)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

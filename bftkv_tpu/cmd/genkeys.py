@@ -1,0 +1,57 @@
+"""Key/topology generator — the GnuPG-scripts replacement.
+
+Builds the canonical universe (reference: scripts/setup.sh:17-48 —
+server clique, storage-only rw nodes, users with quorum certificates)
+and writes one home directory (pubring + secring) per principal, the
+layout :func:`bftkv_tpu.topology.load_home` and the daemon consume.
+
+    python -m bftkv_tpu.cmd.genkeys --out /tmp/keys \
+        --servers 4 --rw 4 --users 2 --base-port 6001
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", required=True, help="directory for the home dirs")
+    ap.add_argument("--servers", type=int, default=4)
+    ap.add_argument("--rw", type=int, default=4)
+    ap.add_argument("--users", type=int, default=1)
+    ap.add_argument("--unsigned-users", type=int, default=0,
+                    help="trailing users without quorum certificates (TOFU)")
+    ap.add_argument("--bits", type=int, default=2048)
+    ap.add_argument("--base-port", type=int, default=6001)
+    ap.add_argument("--rw-base-port", type=int, default=6101)
+    ap.add_argument("--server-trust-rw", action="store_true",
+                    help="servers trust rw nodes in their own views, so "
+                         "daemon client-API reads have a read quorum "
+                         "(extension; not in the reference topology)")
+    args = ap.parse_args(argv)
+
+    from bftkv_tpu import topology
+
+    uni = topology.build_universe(
+        args.servers,
+        args.users,
+        args.rw,
+        scheme="http",
+        base_port=args.base_port,
+        rw_base_port=args.rw_base_port,
+        bits=args.bits,
+        unsigned_users=args.unsigned_users,
+        server_trust_rw=args.server_trust_rw,
+    )
+    os.makedirs(args.out, exist_ok=True)
+    for ident in uni.all:
+        home = os.path.join(args.out, ident.name)
+        topology.save_home(home, ident, uni.view_of(ident))
+        print(f"{ident.name}: {home} ({ident.cert.address or 'client'})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

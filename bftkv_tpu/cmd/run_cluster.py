@@ -1,0 +1,116 @@
+"""Cluster runner — one daemon process per home directory.
+
+The reference spawns one ``bftkv`` per key dir with sequential ports
+(scripts/run.sh:27-41); here the address already lives in each home's
+certificate, so the runner just enumerates server homes (names not
+starting with ``u``) and execs the daemon for each:
+
+    python -m bftkv_tpu.cmd.genkeys --out /tmp/keys --servers 4 --rw 4
+    python -m bftkv_tpu.cmd.run_cluster --keys /tmp/keys --db-root /tmp/dbs
+
+The runner lives until SIGINT/SIGTERM and then tears the fleet down.
+``--api-base`` exposes the client API on sequential ports (reference
+run.sh uses 6001+ for its debug API).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+
+def server_homes(keys_dir: str) -> list[str]:
+    out = []
+    for name in sorted(os.listdir(keys_dir)):
+        home = os.path.join(keys_dir, name)
+        if not os.path.isdir(home) or name.startswith("u"):
+            continue
+        out.append(home)
+    return out
+
+
+def spawn(
+    homes: list[str],
+    db_root: str,
+    *,
+    storage: str = "plain",
+    api_base: int = 0,
+    join: bool = False,
+    client_home: str = "",
+    extra_env: dict | None = None,
+) -> list[subprocess.Popen]:
+    os.makedirs(db_root, exist_ok=True)
+    procs = []
+    env = dict(os.environ, **(extra_env or {}))
+    for i, home in enumerate(homes):
+        name = os.path.basename(home)
+        cmd = [
+            sys.executable, "-m", "bftkv_tpu.cmd.bftkv",
+            "--home", home,
+            "--db", os.path.join(db_root, name),
+            "--storage", storage,
+            "--revlist", os.path.join(db_root, name + ".rev"),
+        ]
+        if api_base:
+            cmd += ["--api", f"127.0.0.1:{api_base + i}"]
+            if client_home:
+                cmd += ["--client-home", client_home]
+        if join:
+            cmd += ["--join"]
+        procs.append(subprocess.Popen(cmd, env=env))
+    return procs
+
+
+def shutdown(procs: list[subprocess.Popen], timeout: float = 10.0) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.send_signal(signal.SIGTERM)
+    deadline = time.monotonic() + timeout
+    for p in procs:
+        try:
+            p.wait(timeout=max(0.1, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description="bftkv cluster runner")
+    ap.add_argument("--keys", required=True, help="directory of home dirs")
+    ap.add_argument("--db-root", required=True)
+    ap.add_argument("--storage", choices=["plain", "native", "mem"],
+                    default="plain")
+    ap.add_argument("--api-base", type=int, default=0,
+                    help="client API port for the first server, +1 each")
+    ap.add_argument("--client-home", default="",
+                    help="user home the client APIs act as (see bftkv --help)")
+    args = ap.parse_args(argv)
+
+    homes = server_homes(args.keys)
+    if not homes:
+        print(f"no server homes under {args.keys}", file=sys.stderr)
+        return 1
+    procs = spawn(homes, args.db_root, storage=args.storage,
+                  api_base=args.api_base, client_home=args.client_home)
+    print(f"run_cluster: {len(procs)} servers up", flush=True)
+
+    stopping = False
+
+    def handler(signum, frame):
+        nonlocal stopping
+        stopping = True
+
+    signal.signal(signal.SIGTERM, handler)
+    signal.signal(signal.SIGINT, handler)
+    while not stopping and all(p.poll() is None for p in procs):
+        time.sleep(0.5)
+    shutdown(procs)
+    print("run_cluster: stopped", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,6 +95,12 @@ class Universe:
     # ids of the servers that counter-sign user certs (a07–a10 analog);
     # users trust the *other* servers.
     cert_signer_ids: set[int] = field(default_factory=set)
+    # Operator extension (not in the reference topology): servers trust
+    # the rw storage nodes in their own views, so a *daemon's own
+    # client* has a non-empty READ quorum (the reference's canonical
+    # setup.sh gives servers no path to rw, so its debug-API reads
+    # cannot reach a read quorum either).
+    server_trust_rw: bool = False
 
     @property
     def all(self) -> list[Identity]:
@@ -120,6 +126,10 @@ class Universe:
             for c in own:
                 if c.id in server_ids:
                     certmod.sign_certificate(c, identity.key)
+        elif self.server_trust_rw and identity.id in server_ids:
+            for c in own:
+                if c.id in rw_ids:
+                    certmod.sign_certificate(c, identity.key)
         return list(by_id.values())
 
 
@@ -133,6 +143,7 @@ def build_universe(
     rw_base_port: int = 6101,
     bits: int = 2048,
     unsigned_users: int = 0,
+    server_trust_rw: bool = False,
 ) -> Universe:
     """The canonical test topology (reference: scripts/setup.sh:17-48).
 
@@ -186,6 +197,7 @@ def build_universe(
         storage_nodes=storage_nodes,
         users=users,
         cert_signer_ids={s.id for s in cert_signers},
+        server_trust_rw=server_trust_rw,
     )
 
 

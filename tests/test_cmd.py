@@ -1,0 +1,131 @@
+"""Operator-surface smoke test: genkeys → run_cluster (real OS
+processes) → bftrw write/read → daemon client API.
+
+This is the deployment shape of the reference — one process per replica
+on localhost HTTP (scripts/run.sh + cmd/bftkv + cmd/bftrw) — which the
+in-process cluster tests cannot cover.
+"""
+
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BASE = 17001
+RW_BASE = 17101
+API_BASE = 17501
+
+ENV = dict(
+    os.environ,
+    PYTHONPATH=REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    JAX_PLATFORMS="cpu",  # daemons must not fight over the single TPU chip
+)
+
+
+def run_cmd(args, **kw):
+    return subprocess.run(
+        [sys.executable, "-m"] + args,
+        env=ENV, cwd=REPO, capture_output=True, timeout=180, **kw
+    )
+
+
+def wait_port(port: int, timeout: float = 60.0) -> None:
+    import socket
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        with socket.socket() as s:
+            s.settimeout(1.0)
+            try:
+                s.connect(("127.0.0.1", port))
+                return
+            except OSError:
+                time.sleep(0.3)
+    raise TimeoutError(f"port {port} never came up")
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    from bftkv_tpu.cmd import run_cluster
+
+    tmp = tmp_path_factory.mktemp("cmd")
+    keys = str(tmp / "keys")
+    dbs = str(tmp / "dbs")
+    gen = run_cmd([
+        "bftkv_tpu.cmd.genkeys", "--out", keys,
+        "--servers", "4", "--rw", "4", "--users", "1", "--bits", "1024",
+        "--base-port", str(BASE), "--rw-base-port", str(RW_BASE),
+    ])
+    assert gen.returncode == 0, gen.stderr.decode()
+
+    homes = run_cluster.server_homes(keys)
+    assert len(homes) == 8
+    # The client APIs act as the user identity: server identities
+    # under-collect collective signatures (their AUTH|PEER quorum
+    # excludes self) and cannot reach the rw nodes in trust distance —
+    # same property as the reference topology.
+    procs = run_cluster.spawn(
+        homes, dbs, storage="native", api_base=API_BASE,
+        client_home=os.path.join(keys, "u01"), extra_env=ENV,
+    )
+    try:
+        for port in (*range(BASE, BASE + 4), *range(RW_BASE, RW_BASE + 4)):
+            wait_port(port)
+        wait_port(API_BASE)
+        yield {"keys": keys, "dbs": dbs, "procs": procs}
+    finally:
+        run_cluster.shutdown(procs)
+
+
+def test_bftrw_write_read_across_processes(cluster):
+    home = os.path.join(cluster["keys"], "u01")
+    w = run_cmd(["bftkv_tpu.cmd.bftrw", "--home", home, "write", "smoke/x",
+                 "hello from bftrw"])
+    assert w.returncode == 0, w.stderr.decode()
+    r = run_cmd(["bftkv_tpu.cmd.bftrw", "--home", home, "read", "smoke/x"])
+    assert r.returncode == 0, r.stderr.decode()
+    assert r.stdout == b"hello from bftrw"
+
+
+def test_daemon_client_api(cluster):
+    # The daemon's own client writes through the quorum...
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{API_BASE}/write/smoke/api", data=b"via api",
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as res:
+        assert res.status == 200
+    # ...and any other replica's API reads it back.
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{API_BASE + 1}/read/smoke/api", timeout=60
+    ) as res:
+        assert res.read() == b"via api"
+
+
+def test_daemon_show_and_metrics(cluster):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{API_BASE}/show", timeout=30
+    ) as res:
+        body = res.read().decode()
+    assert "self: a01" in body and "peer:" in body
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{API_BASE}/metrics", timeout=30
+    ) as res:
+        import json
+
+        snap = json.loads(res.read())
+    assert isinstance(snap, dict)
+
+
+def test_daemon_api_missing_variable(cluster):
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{API_BASE}/read/smoke/none", timeout=60
+        )
+    assert ei.value.code == 404
