@@ -385,6 +385,48 @@ def bench_cluster(
     return res
 
 
+def bench_threshold(rounds: int = 3) -> dict:
+    """BASELINE config 3/4 signing: live (t,n)=(5,9) threshold CA over a
+    9-replica cluster — RSA-2048 and ECDSA P-256 dist_sign rounds
+    (reference analog: protocol/dist_test.go:29-105)."""
+    from bftkv_tpu.crypto import rsa as rsamod
+    from bftkv_tpu.crypto.threshold import ThresholdAlgo
+    from bftkv_tpu.crypto.threshold.ecdsa import generate as ec_generate
+    from bftkv_tpu.ops import dispatch
+    from bftkv_tpu.storage.memkv import MemStorage
+
+    servers, clients = _make_cluster(9, 4, 1, MemStorage)
+    dispatch.install()
+    dispatch.install_signer()
+    c = clients[0]
+    out: dict = {"t": 5, "n": 9}
+    try:
+        ca_rsa = rsamod.generate(2048)
+        c.distribute("bench-rsa", ca_rsa)
+        ca_ec = ec_generate()
+        c.distribute("bench-ecdsa", ca_ec)
+        for algo, name in (
+            (ThresholdAlgo.RSA, "rsa2048"),
+            (ThresholdAlgo.ECDSA, "ecdsa_p256"),
+        ):
+            caname = "bench-" + ("rsa" if algo == ThresholdAlgo.RSA else "ecdsa")
+            c.dist_sign(caname, b"warm", algo, "sha256")  # compile warm-up
+            t0 = time.perf_counter()
+            for i in range(rounds):
+                sig = c.dist_sign(caname, b"bench-tbs-%d" % i, algo, "sha256")
+                assert sig
+            el = time.perf_counter() - t0
+            out[name] = {
+                "signs_per_sec": round(rounds / el, 3),
+                "sign_latency_s": round(el / rounds, 3),
+            }
+    finally:
+        dispatch.uninstall_all()
+        for s in servers:
+            s.tr.stop()
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Batched revoke-on-read tally (BASELINE config 5)
 # ---------------------------------------------------------------------------
@@ -449,7 +491,7 @@ def main() -> None:
         "BENCH_CONFIGS",
         "kernel,modexp,ec,c4,c16,tally"
         if FAST
-        else "kernel,modexp,ec,c4,c4http,c16,c64,tally",
+        else "kernel,modexp,ec,c4,c4http,c16,c64,mix64,thr,tally",
     )
     batches = [int(b) for b in _env_list("BENCH_KERNEL_BATCHES", "256,1024,4096")]
     writers = int(os.environ.get("BENCH_WRITERS", "4" if FAST else "8"))
@@ -483,6 +525,15 @@ def main() -> None:
             64, 0, writers, max(2, writes // 4), storage="mem", dispatch_batch=1024
         )
         headline = extra["cluster_64"]
+    if "mix64" in configs:
+        # BASELINE config 4: 64 replicas, 80/20 read/write mix.
+        extra["cluster_64_mix"] = bench_cluster(
+            64, 0, writers, max(2, writes // 4), storage="mem",
+            dispatch_batch=1024, read_fraction=0.8,
+        )
+    if "thr" in configs:
+        # BASELINE config 3/4: threshold (5,9) RSA + ECDSA signing.
+        extra["threshold_5_9"] = bench_threshold(2 if FAST else 4)
     if "tally" in configs:
         extra["revoke_tally_256"] = bench_tally()
 

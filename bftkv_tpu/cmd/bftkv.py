@@ -8,8 +8,8 @@ certificate's address, and optionally expose a client-facing HTTP API:
     GET/POST /read/<var>      value bytes (404 when absent)
     POST     /write/<var>     body = value
     POST     /writeonce/<var> body = value (t = 2^64-1, immutable)
-    GET      /joining         re-crawl the trust graph
-    GET      /leaving
+    POST     /joining         re-crawl the trust graph
+    POST     /leaving
     GET      /show            trust-graph dump (text)
     GET      /metrics         JSON metrics snapshot (no reference
                               analog; stands in for the visualizer feed)
@@ -64,11 +64,18 @@ def build_server(args):
             from bftkv_tpu.crypto import cert as certmod
 
             revoked = certmod.parse(f.read())
-            graph.revoke_nodes(revoked)
+            # revoke() (not revoke_nodes) so the peers also leave the
+            # vertex set quorum selection reads — matching every other
+            # revocation site (client.py / server.py).
+            for n in revoked:
+                graph.revoke(n)
             if revoked:
                 print(f"revoked {len(revoked)} node(s) from {args.revlist}")
     except OSError:
         pass
+    except Exception as e:
+        # A torn .rev (crash mid-persist) must not brick the daemon.
+        print(f"warning: ignoring unreadable revocation list: {e}")
 
     tr = TrHTTP(crypt)
     server = Server(graph, qs, tr, crypt, storage)
@@ -97,6 +104,11 @@ class _ApiHandler(BaseHTTPRequestHandler):
     def _handle(self):
         svc = self.server.svc
         path = self.path
+        # Always drain the body: HTTP/1.1 keep-alive reuses the
+        # connection, and unread bytes would be parsed as the next
+        # request line.
+        length = int(self.headers.get("content-length", "0") or 0)
+        body = self.rfile.read(length) if length else b""
         if self.command == "GET" and path.startswith(self._MUTATING):
             # Idempotent GETs (prefetchers, probes) must not mutate
             # quorum state.
@@ -110,12 +122,10 @@ class _ApiHandler(BaseHTTPRequestHandler):
                 else:
                     self._reply(200, value)
             elif path.startswith("/write/") or path.startswith("/writeonce/"):
-                length = int(self.headers.get("content-length", "0"))
-                value = self.rfile.read(length)
                 if path.startswith("/write/"):
-                    svc.client.write(self._var("/write/"), value)
+                    svc.client.write(self._var("/write/"), body)
                 else:
-                    svc.client.write_once(self._var("/writeonce/"), value)
+                    svc.client.write_once(self._var("/writeonce/"), body)
                 self._reply(200, b"ok\n", "text/plain")
             elif path == "/joining":
                 svc.client.joining()
@@ -234,11 +244,16 @@ def main(argv: list[str] | None = None) -> int:
     signal.signal(signal.SIGINT, shutdown)
     stop.wait()
 
-    # Persist the revocation list (re-enabling main.go:170-183).
+    # Persist the revocation list atomically (re-enabling
+    # main.go:170-183; a torn write must not poison the next boot).
     rl = graph.serialize_revoked()
     if rl:
-        with open(args.revlist, "wb") as f:
+        tmp = args.revlist + "~"
+        with open(tmp, "wb") as f:
             f.write(rl)
+        import os
+
+        os.replace(tmp, args.revlist)
     if api_httpd is not None:
         api_httpd.shutdown()
     server.stop()

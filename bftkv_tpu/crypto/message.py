@@ -162,10 +162,22 @@ class MessageSecurity:
         recipients: list[certmod.Certificate],
         plaintext: bytes,
         nonce: bytes,
+        *,
+        force_bootstrap: bool = False,
     ) -> bytes:
-        sessions = self._sessions_for(recipients)
-        if sessions is not None:
-            return self._encrypt_session(recipients, sessions, plaintext, nonce)
+        """``force_bootstrap`` always emits the self-contained RSA
+        envelope. The transport's unknown-session retry needs it: a
+        fast-path envelope can overtake its establishing bootstrap (the
+        sender commits a session at *encrypt* time, the receiver learns
+        it at *delivery* time), and a retry that merely invalidates can
+        race with another thread re-installing a not-yet-delivered
+        session — a bootstrap is decryptable unconditionally."""
+        if not force_bootstrap:
+            sessions = self._sessions_for(recipients)
+            if sessions is not None:
+                return self._encrypt_session(
+                    recipients, sessions, plaintext, nonce
+                )
         return self._encrypt_bootstrap(recipients, plaintext, nonce)
 
     def _encrypt_session(

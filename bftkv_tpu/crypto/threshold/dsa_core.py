@@ -327,7 +327,13 @@ class DsaContext:
         out = []
         for i, peer in enumerate(nodes):
             data = _serialize_share(k[i], a[i], b[i], c[i])
-            cipher = self.crypt.message.encrypt([peer], data, nonce)
+            # Shares are store-and-forward (relayed through the client),
+            # so there is no transport retry channel for a session the
+            # recipient never learned — always use the self-contained
+            # bootstrap envelope here.
+            cipher = self.crypt.message.encrypt(
+                [peer], data, nonce, force_bootstrap=True
+            )
             out.append((cipher, peer.id))
         self._nonces[peer_id] = nonce
         return out

@@ -278,6 +278,11 @@ class Client(Protocol):
         metrics.incr("client.read.repair", len(stale))
         self.tr.multicast(tp.WRITE, stale, bucket[0].packet, None)
 
+    #: Signer-entry count above which revoke-on-read tallies on device
+    #: (BASELINE config 5: 256 simulated replicas, f=85 — the sweep is
+    #: one einsum instead of a Python scan over ~10^4 entries).
+    BATCH_REVOKE_THRESHOLD = 512
+
     def _revoke_on_read(self, m) -> None:
         """Signers that signed two different values at the same
         timestamp get revoked; the revocation list is broadcast
@@ -286,22 +291,53 @@ class Client(Protocol):
         for t, vl in m.items():
             if t == 0:
                 continue
-            seen: dict[int, int] = {}  # signer id -> bucket round
-            for round_no, svl in enumerate(vl.values()):
-                for sv in svl:
-                    for sid in sigmod.signers(sv.ss):
+            # One signer-id set per distinct value observed at t.
+            rows: list[set[int]] = [
+                {sid for sv in svl for sid in sigmod.signers(sv.ss)}
+                for svl in vl.values()
+            ]
+            if len(rows) < 2:
+                continue
+            total = sum(len(r) for r in rows)
+            if total >= self.BATCH_REVOKE_THRESHOLD:
+                bad = self._equivocators_batched(rows)
+            else:
+                seen: dict[int, int] = {}
+                bad = set()
+                for round_no, row in enumerate(rows):
+                    for sid in row:
                         prev = seen.get(sid)
                         if prev is None:
                             seen[sid] = round_no
-                        elif prev != round_no and sid not in revoked:
-                            self._do_revoke(sid)
-                            revoked.add(sid)
+                        elif prev != round_no:
+                            bad.add(sid)
+            for sid in bad:
+                if sid not in revoked:
+                    self._do_revoke(sid)
+                    revoked.add(sid)
         if revoked:
             rl = self.self_node.serialize_revoked()
             if rl:
                 self.tr.multicast(
                     tp.NOTIFY, self.self_node.get_peers(), rl, None
                 )
+
+    @staticmethod
+    def _equivocators_batched(rows: list[set[int]]) -> set[int]:
+        """Device sweep: (nvalues, U) bool → equivocator mask in one
+        einsum (ops.tally.equivocation_pairs)."""
+        import numpy as np
+
+        from bftkv_tpu.ops import tally
+
+        ids = sorted(set().union(*rows))
+        index = {sid: i for i, sid in enumerate(ids)}
+        sets = np.zeros((len(rows), len(ids)), dtype=bool)
+        for r, row in enumerate(rows):
+            for sid in row:
+                sets[r, index[sid]] = True
+        mask = np.asarray(tally.equivocation_pairs(sets))
+        return {ids[i] for i in np.nonzero(mask)[0]}
 
     def _do_revoke(self, sid: int) -> None:
         node = self.crypt.keyring.get(sid)
@@ -447,6 +483,7 @@ class Client(Protocol):
             sig_out = None
             err_out: Exception | None = None
             succ = 0
+            errs: list = []
 
             def cb(res: tp.MulticastResponse) -> bool:
                 nonlocal sig_out, err_out, succ
@@ -458,6 +495,8 @@ class Client(Protocol):
                         err_out = e
                         return True
                     return sig_out is not None
+                if res.err is not None:
+                    errs.append(res.err)
                 return False
 
             self.tr.multicast(tp.DISTSIGN, nodes, data, cb)
@@ -468,4 +507,4 @@ class Client(Protocol):
             if sig_out is not None:
                 return sig_out
             if succ == 0:  # no more new responses
-                raise ERR_INSUFFICIENT_NUMBER_OF_RESPONSES
+                raise majority_error(errs, ERR_INSUFFICIENT_NUMBER_OF_RESPONSES)

@@ -177,16 +177,20 @@ def multicast(
                     res = tr.post(addr + PREFIX + name, cipher)
                     plain, _sender, echoed = tr.decrypt(res)
                 except ERR_UNKNOWN_SESSION:
-                    # One side of the pairwise transport session is gone
-                    # (peer restart or cache eviction on either end):
-                    # drop it and retry once with a fresh bootstrap
-                    # envelope for this peer alone.
+                    # The peer does not hold the session this envelope
+                    # used: restart, cache eviction, or our fast-path
+                    # envelope overtook its establishing bootstrap.
+                    # Retry once with a *forced* bootstrap for this peer
+                    # alone — self-contained, decryptable regardless of
+                    # the peer's session state.
                     sec = getattr(tr, "security", None)
                     if sec is None:
                         raise
                     sec.message.invalidate(peer.id)
                     nonce2 = tr.generate_random()
-                    cipher2 = tr.encrypt([peer], payload, nonce2)
+                    cipher2 = sec.message.encrypt(
+                        [peer], payload, nonce2, force_bootstrap=True
+                    )
                     res = tr.post(addr + PREFIX + name, cipher2)
                     plain, _sender, echoed = tr.decrypt(res)
                     if echoed != nonce2:

@@ -121,3 +121,33 @@ def test_threshold_ecdsa_ca(cluster):
         pub[0], pub[1], cec.SECP256R1()
     ).public_key()
     pubkey.verify(encode_dss_signature(r, s), tbs, cec.ECDSA(hashes.SHA256()))
+
+
+def test_threshold_repeated_rounds_5_of_9():
+    """Repeated dist_sign rounds at (t,n)=(5,9): regression for the
+    session-reordering race — a second signing round's server-to-server
+    share envelopes (relayed through the client, no transport retry
+    channel) must stay decryptable even when the recipient never saw
+    the dealer's earlier session bootstrap."""
+    from bftkv_tpu.crypto.threshold import ecdsa as tec
+    from bftkv_tpu.crypto import ec
+
+    c = start_cluster(n_servers=9, n_users=1, n_rw=4, bits=1024)
+    try:
+        cli = c.clients[0]
+        key = rsa.generate(1024)
+        cli.distribute("rrca-rsa", key)
+        eckey = tec.generate(ec.P256)
+        cli.distribute("rrca-ec", eckey)
+        for i in range(2):
+            sig = cli.dist_sign(
+                "rrca-rsa", b"round-%d" % i, ThresholdAlgo.RSA, "sha256"
+            )
+            assert rsa.verify_host(b"round-%d" % i, sig, key.public)
+        for i in range(2):
+            sig = cli.dist_sign(
+                "rrca-ec", b"ec-round-%d" % i, ThresholdAlgo.ECDSA, "sha256"
+            )
+            assert len(sig) == 64
+    finally:
+        c.stop()
