@@ -276,113 +276,117 @@ def bench_cluster(
     )
     setup_s = time.perf_counter() - t_setup
 
-    metrics.reset()
-    dispatch.install(dispatch.VerifyDispatcher(max_batch=dispatch_batch))
-    dispatch.install_signer(
-        dispatch.SignDispatcher(max_batch=max(dispatch_batch // 2, 64))
-    )
-    value = os.urandom(value_size)
-    # Warm the protocol path and the device bucket shapes the run can hit
-    # (pays XLA compilation outside the timed region). A write burst at n
-    # replicas produces ~n·suff verifies, padded to power-of-two buckets.
-    clients[0].write(b"bench/warmup", value)
-    clients[0].read(b"bench/warmup")
-    d = dispatch.get()
-    # The dispatcher chunks flushes at max_batch, so the padded device
-    # shape never exceeds the next power of two above dispatch_batch —
-    # warming larger buckets would compile kernels the run cannot hit.
-    bucket_max = max(256, 1 << (dispatch_batch - 1).bit_length())
-    warm_items = _warm_items(bucket_max)
-    bucket = 256
-    while bucket <= bucket_max:
-        if bucket >= d.verifier.host_threshold:
-            d.verifier.verify_batch(warm_items[:bucket])
-        bucket *= 2
-    ds = dispatch.get_signer()
-    sign_items = [(m, clients[0].crypt.signer.key) for m, _s, _k in warm_items]
-    bucket = 16
-    while bucket <= ds.max_batch:
-        if bucket >= ds.signer.host_threshold:
-            ds.signer.sign_batch(sign_items[:bucket])
-        bucket *= 2
-    metrics.reset()
-
-    errors: list = []
-    reads_by_thread = [0] * writers
-
-    def run(ci: int, client) -> None:
-        rng = np.random.default_rng(ci)
-        try:
-            reads_per_write = (
-                read_fraction / (1 - read_fraction) if read_fraction else 0.0
-            )
-            for i in range(writes_per_writer):
-                client.write(b"bench/%d/%d" % (ci, i), value)
-                k = int(reads_per_write)
-                if rng.random() < reads_per_write - k:
-                    k += 1
-                for _ in range(k):
-                    client.read(b"bench/%d/%d" % (ci, rng.integers(0, i + 1)))
-                    reads_by_thread[ci] += 1
-        except Exception as e:  # surfaced below; bench must not hang
-            errors.append(e)
-
-    threads = [
-        threading.Thread(target=run, args=(ci, c), daemon=True)
-        for ci, c in enumerate(clients[:writers])
-    ]
-    t0 = time.perf_counter()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    elapsed = time.perf_counter() - t0
-    if errors:
-        raise errors[0]
-
-    total_writes = writers * writes_per_writer
-    total_reads = sum(reads_by_thread)
-    # Correctness spot check before reporting a rate.
-    got = clients[0].read(b"bench/0/%d" % (writes_per_writer - 1))
-    assert got == value, "read-back mismatch"
-
-    snap = metrics.snapshot()
-    flushes = snap.get("dispatch.flushes", 0)
-    res = {
-        "replicas": n_servers,
-        "rw_nodes": n_rw,
-        "writers": writers,
-        "writes": total_writes,
-        "reads": total_reads,
-        "value_bytes": value_size,
-        "storage": storage,
-        "transport": transport,
-        "writes_per_sec": round(total_writes / elapsed, 2),
-        "ops_per_sec": round((total_writes + total_reads) / elapsed, 2),
-        "write_p50_s": round(snap.get("client.write.latency.p50", 0), 4),
-        "write_p99_s": round(snap.get("client.write.latency.p99", 0), 4),
-        "read_p50_s": round(snap.get("client.read.latency.p50", 0), 4),
-        "dispatch_flushes": flushes,
-        "dispatch_verifies": snap.get("dispatch.verifies", 0),
-        "dispatch_batch_mean": round(
-            snap.get("dispatch.verifies", 0) / flushes, 2
+    try:
+        metrics.reset()
+        dispatch.install(dispatch.VerifyDispatcher(max_batch=dispatch_batch))
+        dispatch.install_signer(
+            dispatch.SignDispatcher(max_batch=max(dispatch_batch // 2, 64))
         )
-        if flushes
-        else 0,
-        "dispatch_batch_p50": snap.get("dispatch.batch.p50", 0),
-        "verifies_host": snap.get("verify.host", 0),
-        "verifies_device": snap.get("verify.device", 0),
-        "signs_host": snap.get("sign.host", 0),
-        "signs_device": snap.get("sign.device", 0),
-        "sign_batch_p50": snap.get("signdispatch.batch.p50", 0),
-        "setup_s": round(setup_s, 1),
-    }
-    dispatch.uninstall_all()
-    for s in servers:
-        s.tr.stop()
-    if tmp is not None:
-        tmp.cleanup()
-    return res
+        value = os.urandom(value_size)
+        # Warm the protocol path and the device bucket shapes the run can hit
+        # (pays XLA compilation outside the timed region). A write burst at n
+        # replicas produces ~n·suff verifies, padded to power-of-two buckets.
+        clients[0].write(b"bench/warmup", value)
+        clients[0].read(b"bench/warmup")
+        d = dispatch.get()
+        # The dispatcher chunks flushes at max_batch, so the padded device
+        # shape never exceeds the next power of two above dispatch_batch —
+        # warming larger buckets would compile kernels the run cannot hit.
+        bucket_max = max(256, 1 << (dispatch_batch - 1).bit_length())
+        warm_items = _warm_items(bucket_max)
+        bucket = 256
+        while bucket <= bucket_max:
+            if bucket >= d.verifier.host_threshold:
+                d.verifier.verify_batch(warm_items[:bucket])
+            bucket *= 2
+        ds = dispatch.get_signer()
+        sign_items = [(m, clients[0].crypt.signer.key) for m, _s, _k in warm_items]
+        bucket = 16
+        while bucket <= ds.max_batch:
+            if bucket >= ds.signer.host_threshold:
+                ds.signer.sign_batch(sign_items[:bucket])
+            bucket *= 2
+        metrics.reset()
+
+        errors: list = []
+        reads_by_thread = [0] * writers
+
+        def run(ci: int, client) -> None:
+            rng = np.random.default_rng(ci)
+            try:
+                reads_per_write = (
+                    read_fraction / (1 - read_fraction) if read_fraction else 0.0
+                )
+                for i in range(writes_per_writer):
+                    client.write(b"bench/%d/%d" % (ci, i), value)
+                    k = int(reads_per_write)
+                    if rng.random() < reads_per_write - k:
+                        k += 1
+                    for _ in range(k):
+                        client.read(b"bench/%d/%d" % (ci, rng.integers(0, i + 1)))
+                        reads_by_thread[ci] += 1
+            except Exception as e:  # surfaced below; bench must not hang
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run, args=(ci, c), daemon=True)
+            for ci, c in enumerate(clients[:writers])
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+
+        total_writes = writers * writes_per_writer
+        total_reads = sum(reads_by_thread)
+        # Correctness spot check before reporting a rate.
+        got = clients[0].read(b"bench/0/%d" % (writes_per_writer - 1))
+        assert got == value, "read-back mismatch"
+
+        snap = metrics.snapshot()
+        flushes = snap.get("dispatch.flushes", 0)
+        res = {
+            "replicas": n_servers,
+            "rw_nodes": n_rw,
+            "writers": writers,
+            "writes": total_writes,
+            "reads": total_reads,
+            "value_bytes": value_size,
+            "storage": storage,
+            "transport": transport,
+            "writes_per_sec": round(total_writes / elapsed, 2),
+            "ops_per_sec": round((total_writes + total_reads) / elapsed, 2),
+            "write_p50_s": round(snap.get("client.write.latency.p50", 0), 4),
+            "write_p99_s": round(snap.get("client.write.latency.p99", 0), 4),
+            "read_p50_s": round(snap.get("client.read.latency.p50", 0), 4),
+            "dispatch_flushes": flushes,
+            "dispatch_verifies": snap.get("dispatch.verifies", 0),
+            "dispatch_batch_mean": round(
+                snap.get("dispatch.verifies", 0) / flushes, 2
+            )
+            if flushes
+            else 0,
+            "dispatch_batch_p50": snap.get("dispatch.batch.p50", 0),
+            "verifies_host": snap.get("verify.host", 0),
+            "verifies_device": snap.get("verify.device", 0),
+            "signs_host": snap.get("sign.host", 0),
+            "signs_device": snap.get("sign.device", 0),
+            "sign_batch_p50": snap.get("signdispatch.batch.p50", 0),
+            "setup_s": round(setup_s, 1),
+        }
+        return res
+    finally:
+        # One failing section must not leak dispatchers, server
+        # threads, or temp dirs into the next section.
+        dispatch.uninstall_all()
+        for s in servers:
+            s.tr.stop()
+        if tmp is not None:
+            tmp.cleanup()
 
 
 def bench_threshold(rounds: int = 3) -> dict:
@@ -497,45 +501,60 @@ def main() -> None:
     writers = int(os.environ.get("BENCH_WRITERS", "4" if FAST else "8"))
     writes = int(os.environ.get("BENCH_WRITES", "4" if FAST else "16"))
 
-    if "kernel" in configs:
-        extra["verify_kernel"] = bench_kernel_verify(batches)
-    if "modexp" in configs:
-        extra["modexp_kernel"] = bench_kernel_modexp(64 if FAST else 256)
-    if "ec" in configs:
-        extra["ec_kernel"] = bench_kernel_ec((64,) if FAST else (64, 256))
-
     headline = None
+
+    def section(name: str, fn, *a, **kw):
+        """One failing section must not sink the whole bench run."""
+        t0 = time.perf_counter()
+        try:
+            extra[name] = fn(*a, **kw)
+            extra[name]["section_s"] = round(time.perf_counter() - t0, 1)
+            return extra[name]
+        except Exception as e:
+            extra[name] = {"error": f"{type(e).__name__}: {e}"}
+            return None
+
+    if "kernel" in configs:
+        section("verify_kernel", bench_kernel_verify, batches)
+    if "modexp" in configs:
+        section("modexp_kernel", bench_kernel_modexp, 64 if FAST else 256)
+    if "ec" in configs:
+        section("ec_kernel", bench_kernel_ec, (64,) if FAST else (64, 256))
+
     if "c4" in configs:
-        extra["cluster_4"] = bench_cluster(
-            4, 4, writers, writes, storage="plain", dispatch_batch=256
-        )
-        headline = extra["cluster_4"]
+        headline = section(
+            "cluster_4", bench_cluster, 4, 4, writers, writes,
+            storage="plain", dispatch_batch=256,
+        ) or headline
     if "c4http" in configs:
-        extra["cluster_4_http"] = bench_cluster(
-            4, 4, writers, writes, storage="mem", dispatch_batch=256,
-            transport="http",
+        section(
+            "cluster_4_http", bench_cluster, 4, 4, writers, writes,
+            storage="mem", dispatch_batch=256, transport="http",
         )
     if "c16" in configs:
-        extra["cluster_16"] = bench_cluster(
-            16, 4, writers, writes, storage="mem", dispatch_batch=256
-        )
-        headline = extra["cluster_16"]
+        headline = section(
+            "cluster_16", bench_cluster, 16, 4, writers, writes,
+            storage="mem", dispatch_batch=256,
+        ) or headline
     if "c64" in configs:
-        extra["cluster_64"] = bench_cluster(
-            64, 0, writers, max(2, writes // 4), storage="mem", dispatch_batch=1024
-        )
-        headline = extra["cluster_64"]
+        # 8 rw storage nodes: with none, W = U - {Ci} + R is empty and
+        # writes have nowhere to land (wotqs.go:72-115).
+        headline = section(
+            "cluster_64", bench_cluster, 64, 8, writers,
+            max(2, writes // 4), storage="mem", dispatch_batch=1024,
+        ) or headline
     if "mix64" in configs:
         # BASELINE config 4: 64 replicas, 80/20 read/write mix.
-        extra["cluster_64_mix"] = bench_cluster(
-            64, 0, writers, max(2, writes // 4), storage="mem",
-            dispatch_batch=1024, read_fraction=0.8,
+        section(
+            "cluster_64_mix", bench_cluster, 64, 8, writers,
+            max(2, writes // 4), storage="mem", dispatch_batch=1024,
+            read_fraction=0.8,
         )
     if "thr" in configs:
         # BASELINE config 3/4: threshold (5,9) RSA + ECDSA signing.
-        extra["threshold_5_9"] = bench_threshold(2 if FAST else 4)
+        section("threshold_5_9", bench_threshold, 2 if FAST else 4)
     if "tally" in configs:
-        extra["revoke_tally_256"] = bench_tally()
+        section("revoke_tally_256", bench_tally)
 
     extra["total_s"] = round(time.perf_counter() - t_start, 1)
 

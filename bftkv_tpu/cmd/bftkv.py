@@ -77,7 +77,15 @@ def build_server(args):
         # A torn .rev (crash mid-persist) must not brick the daemon.
         print(f"warning: ignoring unreadable revocation list: {e}")
 
-    tr = TrHTTP(crypt)
+    if args.ws:
+        from bftkv_tpu.transport.visual import TrVisual, WsHub
+
+        host, _, port = args.ws.rpartition(":")
+        hub = WsHub((host or "127.0.0.1", int(port)))
+        tr = TrVisual(crypt, hub, graph)
+        print(f"bftkv: visualizer feed @ ws://{host or '127.0.0.1'}:{port}")
+    else:
+        tr = TrHTTP(crypt)
     server = Server(graph, qs, tr, crypt, storage)
     return server, graph, crypt, qs, tr
 
@@ -107,8 +115,12 @@ class _ApiHandler(BaseHTTPRequestHandler):
         # Always drain the body: HTTP/1.1 keep-alive reuses the
         # connection, and unread bytes would be parsed as the next
         # request line.
-        length = int(self.headers.get("content-length", "0") or 0)
-        body = self.rfile.read(length) if length else b""
+        try:
+            length = int(self.headers.get("content-length", "0") or 0)
+            body = self.rfile.read(length) if length > 0 else b""
+        except (ValueError, OSError):
+            self._reply(400, b"bad request\n", "text/plain")
+            return
         if self.command == "GET" and path.startswith(self._MUTATING):
             # Idempotent GETs (prefetchers, probes) must not mutate
             # quorum state.
@@ -135,6 +147,16 @@ class _ApiHandler(BaseHTTPRequestHandler):
                 self._reply(200, b"left\n", "text/plain")
             elif path == "/show":
                 self._reply(200, svc.show().encode(), "text/plain")
+            elif path == "/visual":
+                import os as _os
+
+                page = _os.path.join(
+                    _os.path.dirname(_os.path.dirname(
+                        _os.path.dirname(_os.path.abspath(__file__)))),
+                    "visual", "index.html",
+                )
+                with open(page, "rb") as f:
+                    self._reply(200, f.read(), "text/html")
             elif path == "/metrics":
                 from bftkv_tpu.metrics import registry as metrics
 
@@ -190,6 +212,9 @@ def main(argv: list[str] | None = None) -> int:
                          "replicas require on the full clique; the "
                          "reference has the same property)")
     ap.add_argument("--revlist", default="", help="revocation list file")
+    ap.add_argument("--ws", default="",
+                    help="WebSocket visualizer feed addr host:port "
+                         "(view at /visual on the client API)")
     ap.add_argument("--join", action="store_true",
                     help="crawl the trust graph at startup")
     ap.add_argument("--dispatch", action="store_true",

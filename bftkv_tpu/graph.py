@@ -140,7 +140,15 @@ class Graph:
         if v is not None:
             instance = v.instance
             self.remove_nodes([instance] if instance is not None else [n])
-        self.revoked[n.id] = instance
+        # Keep the best certificate we have: serialize_revoked() skips
+        # entries without one, and a revocation loaded from a persisted
+        # list (whose peer is absent from this graph) must round-trip
+        # to the next persist. ``n`` may be a bare Ref — hasattr guards.
+        if instance is None and hasattr(n, "serialize"):
+            instance = n
+        self.revoked[n.id] = instance if instance is not None else (
+            self.revoked.get(n.id)
+        )
 
     def revoke_nodes(self, nodes: list) -> None:
         self._bump_generation()

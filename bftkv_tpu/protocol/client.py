@@ -332,11 +332,15 @@ class Client(Protocol):
 
         ids = sorted(set().union(*rows))
         index = {sid: i for i, sid in enumerate(ids)}
-        sets = np.zeros((len(rows), len(ids)), dtype=bool)
+        # Pad both dims to power-of-two buckets: the kernel is jitted
+        # per shape and the signer universe varies read to read.
+        u = 1 << (len(ids) - 1).bit_length()
+        nv = 1 << (len(rows) - 1).bit_length()
+        sets = np.zeros((nv, u), dtype=bool)
         for r, row in enumerate(rows):
             for sid in row:
                 sets[r, index[sid]] = True
-        mask = np.asarray(tally.equivocation_pairs(sets))
+        mask = np.asarray(tally.equivocation_pairs(sets))[: len(ids)]
         return {ids[i] for i in np.nonzero(mask)[0]}
 
     def _do_revoke(self, sid: int) -> None:

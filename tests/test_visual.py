@@ -1,0 +1,126 @@
+"""Visual transport: WebSocket handshake, graph snapshot, request feed
+(reference: transport/http-visual/http-visual.go:43-173)."""
+
+import base64
+import hashlib
+import json
+import os
+import socket
+import struct
+import time
+
+import pytest
+
+from bftkv_tpu import topology
+from bftkv_tpu.protocol.client import Client
+from bftkv_tpu.protocol.server import Server
+from bftkv_tpu.storage.memkv import MemStorage
+from bftkv_tpu.transport.http import TrHTTP
+from bftkv_tpu.transport.visual import TrVisual, WsHub
+
+WS_PORT = 17801
+BASE = 17821
+
+
+def _ws_connect(port: int) -> tuple[socket.socket, bytes]:
+    """Returns (socket, leftover): frames pushed right after the 101
+    can land in the same recv as the handshake response."""
+    s = socket.create_connection(("127.0.0.1", port), timeout=10)
+    key = base64.b64encode(os.urandom(16)).decode()
+    s.sendall(
+        (
+            f"GET / HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+            f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
+            f"Sec-WebSocket-Version: 13\r\n\r\n"
+        ).encode()
+    )
+    resp = b""
+    while b"\r\n\r\n" not in resp:
+        resp += s.recv(4096)
+    head, _, leftover = resp.partition(b"\r\n\r\n")
+    assert b"101" in head.split(b"\r\n")[0]
+    want = base64.b64encode(
+        hashlib.sha1(
+            (key + "258EAFA5-E914-47DA-95CA-C5AB0DC85B11").encode()
+        ).digest()
+    )
+    assert want in head
+    return s, leftover
+
+
+def _read_frames(s: socket.socket, timeout: float = 10.0, initial: bytes = b""):
+    s.settimeout(timeout)
+    buf = initial
+    while True:
+        try:
+            while True:
+                # parse as many complete frames as buffered
+                if len(buf) >= 2:
+                    ln = buf[1] & 0x7F
+                    off = 2
+                    if ln == 126:
+                        if len(buf) < 4:
+                            pass
+                        ln = struct.unpack(">H", buf[2:4])[0]
+                        off = 4
+                    if len(buf) >= off + ln:
+                        yield json.loads(buf[off : off + ln])
+                        buf = buf[off + ln :]
+                        continue
+                break
+            chunk = s.recv(65536)
+            if not chunk:
+                return
+            buf += chunk
+        except socket.timeout:
+            return
+
+
+def test_visual_feed_end_to_end():
+    uni = topology.build_universe(
+        4, 1, 4, scheme="http", base_port=BASE, rw_base_port=BASE + 20,
+        bits=1024,
+    )
+    hub = WsHub(("127.0.0.1", WS_PORT))
+    servers = []
+    try:
+        for i, ident in enumerate(uni.servers + uni.storage_nodes):
+            graph, crypt, qs = topology.make_node(ident, uni.view_of(ident))
+            # first server narrates to the hub; the rest are plain HTTP
+            tr = TrVisual(crypt, hub, graph) if i == 0 else TrHTTP(crypt)
+            srv = Server(graph, qs, tr, crypt, MemStorage())
+            srv.start()
+            servers.append(srv)
+
+        ws, leftover = _ws_connect(WS_PORT)
+        time.sleep(0.2)
+
+        g, cr, q = topology.make_node(uni.users[0], uni.view_of(uni.users[0]))
+        client = Client(g, q, TrHTTP(cr), cr)
+        client.write(b"vis/x", b"hello")
+        assert client.read(b"vis/x") == b"hello"
+
+        events = list(_read_frames(ws, timeout=3.0, initial=leftover))
+        types = {e["type"] for e in events}
+        assert "graph" in types, events
+        cmds = {e.get("command") for e in events if e["type"] == "request"}
+        # the narrated node served at least the write-path commands
+        assert {"time", "sign", "write"} & cmds, events
+        graph_evt = next(e for e in events if e["type"] == "graph")
+        assert any(n["self"] for n in graph_evt["nodes"])
+        assert graph_evt["edges"]
+        ws.close()
+    finally:
+        for srv in servers:
+            srv.tr.stop()
+        hub.stop()
+
+
+def test_visual_page_exists():
+    page = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "visual", "index.html",
+    )
+    with open(page) as f:
+        body = f.read()
+    assert "WebSocket" in body and "drawGraph" in body
