@@ -498,7 +498,9 @@ def main() -> None:
         else "kernel,modexp,ec,c4,c4http,c16,c64,mix64,thr,tally",
     )
     batches = [int(b) for b in _env_list("BENCH_KERNEL_BATCHES", "256,1024,4096")]
-    writers = int(os.environ.get("BENCH_WRITERS", "4" if FAST else "8"))
+    # Throughput is occupancy-driven (shared device launches amortize
+    # across concurrent writers), so the default is deliberately high.
+    writers = int(os.environ.get("BENCH_WRITERS", "4" if FAST else "16"))
     writes = int(os.environ.get("BENCH_WRITES", "4" if FAST else "16"))
 
     headline = None

@@ -219,8 +219,18 @@ class SignDispatcher(_BatchDispatcher):
 
     name = "signdispatch"
 
-    def __init__(self, signer=None, *, max_batch: int = 1024, max_wait: float = 0.002):
-        super().__init__(max_batch=max_batch, max_wait=max_wait)
+    #: A sign launch costs ~115 ms regardless of batch, so waiting
+    #: 20 ms to fill it is cheap: measured at 16 replicas, 2 ms flushes
+    #: give batch-p50 ~17 and ~2 writes/s; 20 ms gives ~41 and ~15.
+    DEFAULT_MAX_WAIT = 0.02
+
+    def __init__(
+        self, signer=None, *, max_batch: int = 1024, max_wait: float | None = None
+    ):
+        super().__init__(
+            max_batch=max_batch,
+            max_wait=self.DEFAULT_MAX_WAIT if max_wait is None else max_wait,
+        )
         if signer is None:
             from bftkv_tpu.crypto import rsa as rsamod
 

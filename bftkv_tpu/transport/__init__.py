@@ -101,6 +101,55 @@ class MulticastResponse:
     err: Exception | None
 
 
+class _DaemonPool:
+    """Reusable daemon-thread pool for the multicast fan-out.
+
+    The reference spawns one goroutine per peer per multicast
+    (transport.go:110-127), which is cheap in Go; a Python thread is
+    not — a three-phase write over 64 replicas would create ~200
+    threads. This pool grows lazily, reuses idle workers, and differs
+    from ``concurrent.futures`` in two load-bearing ways: workers are
+    *daemonic* (abandoned early-exit posts must not block interpreter
+    exit), and the cap is high enough (4096) that nested multicasts —
+    a loopback handler running on a pool worker and broadcasting NOTIFY
+    — cannot realistically starve into the circular-wait deadlock a
+    small bounded pool would allow.
+    """
+
+    def __init__(self, max_workers: int = 4096):
+        self._q: "queue.Queue[Callable[[], None]]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._idle = 0
+        self._count = 0
+        self._max = max_workers
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            spawn = self._idle == 0 and self._count < self._max
+            if spawn:
+                self._count += 1
+        self._q.put(fn)
+        if spawn:
+            threading.Thread(
+                target=self._worker, daemon=True, name="bftkv-fanout"
+            ).start()
+
+    def _worker(self) -> None:
+        while True:
+            with self._lock:
+                self._idle += 1
+            fn = self._q.get()
+            with self._lock:
+                self._idle -= 1
+            try:
+                fn()
+            except Exception:  # workers must survive any task error
+                pass
+
+
+_pool = _DaemonPool()
+
+
 class TransportServer(Protocol):
     """(reference: transport.go:50-52)."""
 
@@ -207,7 +256,7 @@ def multicast(
             except Exception as e:
                 ch.put(MulticastResponse(peer, None, e))
 
-        threading.Thread(target=work, daemon=True).start()
+        _pool.submit(work)
         launched += 1
 
     for _ in range(launched):
