@@ -271,15 +271,28 @@ class VerifierDomain:
     #: forces everything through the kernel (tests, profiling).
     HOST_CROSSOVER = 192
 
-    def __init__(self, nlimbs: int = 128, host_threshold: int | None = None):
+    def __init__(
+        self,
+        nlimbs: int = 128,
+        host_threshold: int | None = None,
+        backend: str | None = None,
+    ):
+        import os
+
         self.nlimbs = nlimbs
         if host_threshold is None:
-            import os
-
             host_threshold = int(
                 os.environ.get("BFTKV_HOST_VERIFY_THRESHOLD", self.HOST_CROSSOVER)
             )
         self.host_threshold = host_threshold
+        #: "rns" (default): residue-number-system f32/MXU kernel, ~19x
+        #: the limb kernel at large batch; "limb": the XLA Montgomery
+        #: limb kernel; "pallas": the VMEM-resident limb chain. Hostile
+        #: keys the RNS path cannot take (shared factor with a channel
+        #: prime, etc.) fall back per item.
+        self.backend = backend or os.environ.get("BFTKV_VERIFY_BACKEND", "rns")
+        if self.backend not in ("rns", "limb", "pallas"):
+            raise ValueError(f"unknown verify backend {self.backend!r}")
         self._cache: "OrderedDict[int, bigint.MontgomeryDomain | None]" = (
             OrderedDict()
         )
@@ -358,6 +371,8 @@ class VerifierDomain:
             metrics.incr("verify.host", len(device_items))
             for j, (message, sig_bytes, key) in zip(device_idx, device_items):
                 out[j] = verify_host(message, sig_bytes, key)
+        elif device_items and self.backend == "rns":
+            self._verify_rns(device_idx, device_items, out)
         elif device_items:
             metrics.incr("verify.device", len(device_items))
             sig, em, n, npr, r2 = self.assemble(device_items)
@@ -379,6 +394,67 @@ class VerifierDomain:
 
                 sig = pad(sig, False)
                 em, n, npr, r2 = (pad(a, True) for a in (em, n, npr, r2))
-            ok = np.asarray(rsa_ops.verify_batch_e65537(sig, em, n, npr, r2))[:k]
+            if self.backend == "pallas":
+                import jax
+
+                from bftkv_tpu.ops import pallas_mont
+
+                ok = np.asarray(
+                    pallas_mont.verify_e65537(
+                        sig, em, n, npr, r2,
+                        interpret=jax.default_backend() not in ("tpu",),
+                    )
+                )[:k]
+            else:
+                ok = np.asarray(
+                    rsa_ops.verify_batch_e65537(sig, em, n, npr, r2)
+                )[:k]
             out[np.asarray(device_idx)] = ok
         return out
+
+    def _verify_rns(self, device_idx, device_items, out) -> None:
+        """RNS device path with per-item fallback for incapable keys."""
+        from bftkv_tpu.ops import rns
+
+        ctx = rns.context()
+        rows, digit_rows, em_rows, keep_idx = [], [], [], []
+        for j, (message, sig_bytes, key) in zip(device_idx, device_items):
+            kr = ctx.key_rows(key.n)
+            s = int.from_bytes(sig_bytes, "big")
+            if kr is None or s >= key.n:
+                # Hostile modulus (or oversized sig): host oracle,
+                # failing closed on junk.
+                metrics.incr("verify.host")
+                try:
+                    out[j] = s < key.n and verify_host(
+                        message, sig_bytes, key
+                    )
+                except Exception:
+                    out[j] = False
+                continue
+            rows.append(kr)
+            digit_rows.append(limb.int_to_limbs(s, 128))
+            em_rows.append(
+                limb.int_to_limbs(
+                    emsa_pkcs1v15_sha256(message, key.size_bytes), 128
+                )
+            )
+            keep_idx.append(j)
+        if not rows:
+            return
+        k = len(rows)
+        metrics.incr("verify.device", k)
+        # Power-of-two buckets (floor 256), padding with row 0's key and
+        # sig digits of 0 — 0^e never equals a PKCS#1 encoding.
+        padded = max(256, 1 << (k - 1).bit_length())
+        for _ in range(padded - k):
+            rows.append(rows[0])
+            digit_rows.append(np.zeros(128, dtype=np.uint32))
+            em_rows.append(em_rows[0])
+        key_rows = rns.stack_key_rows(rows)
+        ok = np.asarray(
+            rns.verify_e65537_rns(
+                np.stack(digit_rows), np.stack(em_rows), key_rows
+            )
+        )[:k]
+        out[np.asarray(keep_idx)] = ok

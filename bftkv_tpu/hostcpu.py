@@ -38,6 +38,16 @@ def force_cpu(n_devices: int = 8) -> None:
     import jax  # deferred: may or may not already be imported
     import jax._src.xla_bridge as xb
 
+    # Pallas registers TPU lowering rules at import time and refuses if
+    # "tpu" is no longer a known platform — import it before the
+    # factories are trimmed so interpret-mode kernels keep working on
+    # the CPU lane.
+    try:
+        import jax.experimental.pallas  # noqa: F401
+        import jax.experimental.pallas.tpu  # noqa: F401
+    except Exception:
+        pass
+
     factories = getattr(xb, "_backend_factories", None)
     if isinstance(factories, dict):
         for name in [k for k in factories if k != "cpu"]:

@@ -1,0 +1,418 @@
+"""RSA verification in a residue number system — MXU/f32-native bignum.
+
+The limb kernels (:mod:`bftkv_tpu.ops.bigint`, the Pallas variant) are
+bound by *emulated* 32-bit integer multiplies on the VPU — a 128-limb
+Montgomery product is a 128-step convolution of digit products, and
+every digit product pays the int32-mul emulation tax. This module
+removes both the convolution and the integer arithmetic:
+
+- numbers live as residues modulo ~2k primes of 11-12 bits (two RNS
+  bases B, B' plus a 2^12 redundant channel), so multiplication is
+  *channelwise*: one native f32 multiply per lane (products < 2^24 are
+  exactly representable) plus a Barrett reduction — f32 reciprocal,
+  floor, and ≤2 conditional fixups, all native VPU ops;
+- Montgomery reduction (Bajard et al.) needs two base extensions per
+  product; each is Σ_i σ_i·(M/p_i mod target) — a matrix product whose
+  matrix depends only on the prime bases, NOT the data → it runs on
+  the MXU as four *exact* f32 matmuls (operands split into 6-bit
+  halves, so every partial sum stays < 2^24);
+- the B→B' extension is approximate (off by α·M, α < k — harmless:
+  the bases carry ~200 bits of slack over 2048-bit moduli), while the
+  B'→B return extension is made *exact* with the Shenoy–Kumaresan
+  correction through the 2^12 redundant channel, keeping the bases
+  consistent;
+- the final check needs no RNS→positional conversion: with
+  v ≡ s^e (mod N) and v < (k+1)·N, Δ_j = (v_j − em_j)·N⁻¹ mod p_j is
+  the same small integer α = (v − em)/N in *every* channel iff the
+  signature is valid; ~2k independent channels cannot agree otherwise.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["RNSContext", "context", "verify_e65537_rns"]
+
+PR_BITS = 12
+PR = 1 << PR_BITS  # redundant modulus (power of two)
+DIGITS = 128  # 16-bit digits per 2048-bit number
+SPLIT = 6  # matmul operand split (values < 64: f32 partials stay exact)
+
+
+def _gen_primes(lo: int, hi: int) -> list[int]:
+    sieve = np.ones(hi - lo, dtype=bool)
+    for p in range(2, int(hi**0.5) + 1):
+        start = max(p * p, ((lo + p - 1) // p) * p)
+        sieve[start - lo :: p] = False
+    return [int(lo + i) for i in np.nonzero(sieve)[0]]
+
+
+class RNSContext:
+    """Shared (key-independent) precomputation for one digit width."""
+
+    def __init__(self, digits: int = DIGITS, n_bits: int = 2048):
+        # All primes below 2^12, largest first; two interleaved bases
+        # so both get ~equal bit mass. Each base must clear n_bits by a
+        # healthy margin (the AMM slack analysis needs M > (k+2)^2 N).
+        primes = [p for p in _gen_primes(1 << 10, 1 << PR_BITS)][::-1]
+        need = n_bits + 64
+        self.pb: list[int] = []
+        self.pq: list[int] = []
+        bits_b = bits_q = 0.0
+        for p in primes:
+            if bits_b <= bits_q:
+                self.pb.append(p)
+                bits_b += np.log2(p)
+            else:
+                self.pq.append(p)
+                bits_q += np.log2(p)
+            if bits_b > need and bits_q > need:
+                break
+        else:
+            raise ValueError("not enough sub-2^12 primes for the bases")
+        # Equal channel counts keep the matmul shapes square-ish.
+        k = min(len(self.pb), len(self.pq))
+        self.pb, self.pq = self.pb[:k], self.pq[:k]
+        self.k = k
+        self.digits = digits
+        self.M = 1
+        for p in self.pb:
+            self.M *= p
+        self.Mq = 1
+        for q in self.pq:
+            self.Mq *= q
+        if self.M <= (1 << need) or self.Mq <= (1 << need):
+            raise ValueError("base bit mass too small")
+
+        f = lambda xs: np.asarray(xs, dtype=np.float32)
+        self.p_all = f(self.pb + self.pq)
+        self.inv_all = np.float32(1.0) / self.p_all  # Barrett reciprocals
+
+        # --- extension B -> B' (+ redundant channel) ------------------
+        Mi = [self.M // p for p in self.pb]
+        self.invMi_b = f([pow(Mi[i] % p, -1, p) for i, p in enumerate(self.pb)])
+        E1 = np.zeros((k, k + 1), dtype=np.int64)
+        for i in range(k):
+            for j, q in enumerate(self.pq):
+                E1[i, j] = Mi[i] % q
+            E1[i, k] = Mi[i] % PR
+        self._E1 = self._split6(E1)
+
+        # --- extension B' -> B (+ redundant channel, Shenoy) ----------
+        Mqj = [self.Mq // q for q in self.pq]
+        self.invMi_q = f([pow(Mqj[j] % q, -1, q) for j, q in enumerate(self.pq)])
+        E2 = np.zeros((k, k + 1), dtype=np.int64)
+        for j in range(k):
+            for i, p in enumerate(self.pb):
+                E2[j, i] = Mqj[j] % p
+            E2[j, k] = Mqj[j] % PR
+        self._E2 = self._split6(E2)
+        self.Mq_mod_b = f([self.Mq % p for p in self.pb])
+        self.invMq_pr = np.float32(pow(self.Mq % PR, -1, PR))
+        self.invM_q = f([pow(self.M % q, -1, q) for q in self.pq])
+        self.invM_pr = np.float32(pow(self.M % PR, -1, PR))
+
+        # --- digit -> residue conversion ------------------------------
+        # Digits are 16-bit; split each into two 8-bit halves so the
+        # conversion matmul operands stay < 2^8 (f32 partial sums over
+        # 256 half-digits < 256·255·2^12 ≈ 2^26 — too big; split the
+        # *matrix* to 6 bits instead and the data to 8: partials
+        # < 256·255·63 ≈ 2^22 — exact).
+        D = np.zeros((2 * digits, 2 * k + 1), dtype=np.int64)
+        for d in range(digits):
+            w_lo = pow(1 << 16, d)
+            w_hi = (w_lo << 8)
+            for ch, p in enumerate(self.pb + self.pq):
+                D[2 * d, ch] = w_lo % p
+                D[2 * d + 1, ch] = w_hi % p
+            D[2 * d, 2 * k] = w_lo % PR
+            D[2 * d + 1, 2 * k] = w_hi % PR
+        self._D = self._split6(D)
+
+    @staticmethod
+    def _split6(m: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """12-bit entries → two 6-bit f32 planes."""
+        return (
+            (m & 63).astype(np.float32),
+            (m >> 6).astype(np.float32),
+        )
+
+    # -- per-key (per modulus N) data, host side ------------------------
+
+    @functools.lru_cache(maxsize=4096)
+    def key_rows(self, n: int):
+        """Channel constants for one public modulus ``n`` (cached).
+
+        Returns None for modulo that cannot ride the RNS path: even,
+        too wide for the digit budget, or sharing a factor with a
+        channel prime — real RSA moduli never do, but certificates are
+        attacker-supplied, so such keys must fall back, not raise.
+        """
+        if n <= 0 or n % 2 == 0 or n.bit_length() > 16 * self.digits:
+            return None
+        chans = self.pb + self.pq
+        for p in chans:
+            if n % p == 0:
+                return None
+        f = lambda xs: np.asarray(xs, dtype=np.float32)
+        n_all = f([n % p for p in chans])
+        n_r = np.float32(n % PR)
+        neg_ninv_b = f([(-pow(n, -1, p)) % p for p in self.pb])
+        ninv_all = f([pow(n % p, -1, p) for p in chans])
+        m2 = (self.M * self.M) % n
+        m2_all = f([m2 % p for p in chans])
+        m2_r = np.float32(m2 % PR)
+        return n_all, n_r, neg_ninv_b, ninv_all, m2_all, m2_r
+
+
+@functools.lru_cache(maxsize=4)
+def context(digits: int = DIGITS, n_bits: int = 2048) -> RNSContext:
+    return RNSContext(digits, n_bits)
+
+
+# ---------------------------------------------------------------------------
+# Device side. All tensors are f32 holding exact integers < 2^24;
+# channels ride the last axis. One number = (xb (T,k), xq (T,k), xr (T,1)).
+# ---------------------------------------------------------------------------
+
+_PRF = np.float32(PR)
+_INV_PRF = np.float32(1.0 / PR)
+
+
+def _barrett(x, inv_p, p):
+    """x mod p for integral f32 x < 2^24; exact via reciprocal + fixups."""
+    q = jnp.floor(x * inv_p)
+    r = x - q * p
+    r = jnp.where(r < 0, r + p, r)
+    r = jnp.where(r < 0, r + p, r)
+    r = jnp.where(r >= p, r - p, r)
+    r = jnp.where(r >= p, r - p, r)
+    return r
+
+
+def _mulmod(a, b, inv_p, p):
+    return _barrett(a * b, inv_p, p)
+
+
+def _addmod(a, b, p):
+    s = a + b
+    return jnp.where(s >= p, s - p, s)
+
+
+def _submod(a, b, p):
+    d = a - b
+    return jnp.where(d < 0, d + p, d)
+
+
+def _mod_r(x):
+    """x mod 2^12 for integral f32 x < 2^24 (exact)."""
+    return x - jnp.floor(x * _INV_PRF) * _PRF
+
+
+def _mulmod_r(a, b):
+    return _mod_r(a * b)
+
+
+def _matmul_f32(x, m_split):
+    """Exact Σ_i x[i]·M[i,j] via f32 MXU matmuls.
+
+    ``x`` (T,rows) f32 integral < 2^12, split into 6-bit halves; the
+    matrix is pre-split. Partial sums < rows·63·63·... each partial
+    product < 2^12, summed over ≤ 400 rows < 2^21 — exact in f32.
+    Returns (s_ll, s_mid, s_hh).
+    """
+    mlo, mhi = m_split
+    xlo = x - jnp.floor(x * np.float32(1 / 64)) * 64  # x & 63, f32-exact
+    xhi = jnp.floor(x * np.float32(1 / 64))
+    dot = lambda a, b: jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    s_ll = dot(xlo, mlo)
+    s_mid = dot(xlo, mhi) + dot(xhi, mlo)
+    s_hh = dot(xhi, mhi)
+    return s_ll, s_mid, s_hh
+
+
+def _combine_mod(s_ll, s_mid, s_hh, inv_p, p):
+    """(s_ll + 2^6·s_mid + 2^12·s_hh) mod p, channelwise, f32-exact.
+
+    Partials < 2^22; reduce each below p (< 2^12) before shifting so
+    every intermediate stays < 2^24."""
+    a = _barrett(s_ll, inv_p, p)
+    b = _barrett(s_mid, inv_p, p)
+    d = _barrett(s_hh, inv_p, p)
+    b6 = _barrett(b * 64, inv_p, p)
+    d12 = _barrett(_barrett(d * 64, inv_p, p) * 64, inv_p, p)
+    return _addmod(_addmod(a, b6, p), d12, p)
+
+
+def _combine_mod_r(s_ll, s_mid, s_hh):
+    return _mod_r(_mod_r(s_ll) + _mod_r(s_mid * 64) + _mod_r(_mod_r(s_hh * 64) * 64))
+
+
+class _Consts:
+    """Device-resident context constants bundled for one jit call."""
+
+    def __init__(self, ctx: RNSContext):
+        self.k = ctx.k
+        j = jnp.asarray
+        self.pb = j(ctx.p_all[: ctx.k])
+        self.pq = j(ctx.p_all[ctx.k :])
+        self.ib = j(ctx.inv_all[: ctx.k])
+        self.iq = j(ctx.inv_all[ctx.k :])
+        self.invMi_b = j(ctx.invMi_b)
+        self.invMi_q = j(ctx.invMi_q)
+        self.E1 = (j(ctx._E1[0]), j(ctx._E1[1]))
+        self.E2 = (j(ctx._E2[0]), j(ctx._E2[1]))
+        self.D = (j(ctx._D[0]), j(ctx._D[1]))
+        self.Mq_mod_b = j(ctx.Mq_mod_b)
+        self.invMq_pr = jnp.float32(ctx.invMq_pr)
+        self.invM_q = j(ctx.invM_q)
+        self.invM_pr = jnp.float32(ctx.invM_pr)
+
+
+def _mont_mul(cn, a, b, key):
+    """RNS Montgomery product (Bajard AMM + Shenoy return extension)."""
+    ab, aq, ar = a
+    bb, bq, br = b
+    n_all, n_r, neg_ninv_b, _ninv, _m2, _m2r = key
+    k = cn.k
+    nq = n_all[:, k:]
+
+    db = _mulmod(ab, bb, cn.ib, cn.pb)
+    dq = _mulmod(aq, bq, cn.iq, cn.pq)
+    dr = _mulmod_r(ar, br)
+
+    # q = d·(−N⁻¹) mod M, channelwise in B.
+    qb = _mulmod(db, neg_ninv_b, cn.ib, cn.pb)
+    # Approximate extension of q̂ = Σ σ_i·M_i (= q + α₁M) to B' ∪ {2^12}.
+    sigma = _mulmod(qb, cn.invMi_b, cn.ib, cn.pb)
+    s_ll, s_mid, s_hh = _matmul_f32(sigma, cn.E1)
+    qhat_q = _combine_mod(s_ll[:, :k], s_mid[:, :k], s_hh[:, :k], cn.iq, cn.pq)
+    qhat_r = _combine_mod_r(s_ll[:, k:], s_mid[:, k:], s_hh[:, k:])
+
+    # r = (d + q̂·N)/M in B' and the redundant channel.
+    t = _mulmod(qhat_q, nq, cn.iq, cn.pq)
+    rq = _mulmod(_addmod(dq, t, cn.pq), cn.invM_q, cn.iq, cn.pq)
+    tr = _mulmod_r(qhat_r, n_r)
+    rr = _mulmod_r(_mod_r(dr + tr), cn.invM_pr)
+
+    # Exact extension of r from B' back to B (Shenoy via 2^12 channel).
+    sigma2 = _mulmod(rq, cn.invMi_q, cn.iq, cn.pq)
+    z_ll, z_mid, z_hh = _matmul_f32(sigma2, cn.E2)
+    ext_b = _combine_mod(z_ll[:, :k], z_mid[:, :k], z_hh[:, :k], cn.ib, cn.pb)
+    ext_r = _combine_mod_r(z_ll[:, k:], z_mid[:, k:], z_hh[:, k:])
+    alpha = _mulmod_r(_mod_r(ext_r - rr + _PRF), cn.invMq_pr)
+    corr = _mulmod(
+        jnp.broadcast_to(alpha, ext_b.shape),
+        jnp.broadcast_to(cn.Mq_mod_b, ext_b.shape),
+        cn.ib,
+        cn.pb,
+    )
+    rb = _submod(ext_b, corr, cn.pb)
+    return rb, rq, rr
+
+
+def _to_residues(cn, digit_halves):
+    """(T, 256) 8-bit digit halves → residues over [B | B' | 2^12]."""
+    s_ll, s_mid, s_hh = _matmul_f32(digit_halves, cn.D)
+    k = cn.k
+    xb = _combine_mod(s_ll[:, :k], s_mid[:, :k], s_hh[:, :k], cn.ib, cn.pb)
+    xq = _combine_mod(
+        s_ll[:, k : 2 * k], s_mid[:, k : 2 * k], s_hh[:, k : 2 * k],
+        cn.iq, cn.pq,
+    )
+    xr = _combine_mod_r(s_ll[:, 2 * k :], s_mid[:, 2 * k :], s_hh[:, 2 * k :])
+    return xb, xq, xr
+
+
+def _verify_kernel(cn: _Consts, sig_halves, em_halves, key):
+    n_all, n_r, neg_ninv_b, ninv_all, m2_all, m2_r = key
+    k = cn.k
+    s = _to_residues(cn, sig_halves)
+    em_b, em_q, _em_r = _to_residues(cn, em_halves)
+
+    m2 = (m2_all[:, :k], m2_all[:, k:], m2_r)
+    sm = _mont_mul(cn, s, m2, key)  # to Montgomery form
+
+    acc = sm
+    for _ in range(16):
+        acc = _mont_mul(cn, acc, acc, key)
+    acc = _mont_mul(cn, acc, sm, key)
+
+    one = (jnp.ones_like(sm[0]), jnp.ones_like(sm[1]), jnp.ones_like(sm[2]))
+    vb, vq, _vr = _mont_mul(cn, acc, one, key)  # v ≡ s^e (mod N), v < (k+1)N
+
+    # Δ_j = (v_j − em_j)·N⁻¹ mod p_j: the same small α in every channel
+    # iff v ≡ em (mod N).
+    delta_b = _mulmod(_submod(vb, em_b, cn.pb), ninv_all[:, :k], cn.ib, cn.pb)
+    delta_q = _mulmod(_submod(vq, em_q, cn.pq), ninv_all[:, k:], cn.iq, cn.pq)
+    alpha = delta_b[:, :1]
+    ok = jnp.all(delta_b == alpha, axis=1) & jnp.all(delta_q == alpha, axis=1)
+    return ok & (alpha[:, 0] <= cn.k + 1)
+
+
+@functools.lru_cache(maxsize=1)
+def _jitted_verify():
+    cn = _Consts(context())
+
+    @jax.jit
+    def f(sig_halves, em_halves, key):
+        return _verify_kernel(cn, sig_halves, em_halves, key)
+
+    return f
+
+
+def digits_to_halves(digits_u32: np.ndarray) -> np.ndarray:
+    """(T, 128) 16-bit digits → (T, 256) interleaved 8-bit halves (f32)."""
+    t = digits_u32.shape[0]
+    out = np.empty((t, 2 * digits_u32.shape[1]), dtype=np.float32)
+    out[:, 0::2] = (digits_u32 & 0xFF).astype(np.float32)
+    out[:, 1::2] = (digits_u32 >> 8).astype(np.float32)
+    return out
+
+
+def verify_e65537_rns(sig_digits, em_digits, key_rows) -> jnp.ndarray:
+    """Batched RSA e=65537 verify in RNS.
+
+    ``sig_digits``/``em_digits``: (T, 128) uint32 16-bit digit arrays;
+    ``key_rows``: stacked per-row key tensors from
+    :meth:`RNSContext.key_rows` — (n_all (T,2k), n_r (T,1),
+    neg_ninv_b (T,k), ninv_all (T,2k), m2_all (T,2k), m2_r (T,1)).
+    """
+    sig_h = digits_to_halves(np.asarray(sig_digits))
+    em_h = digits_to_halves(np.asarray(em_digits))
+    return _jitted_verify()(sig_h, em_h, key_rows)
+
+
+def stack_key_rows(rows: list):
+    """Stack per-key row tuples (from :meth:`RNSContext.key_rows`) into
+    the batch tensors ``verify_e65537_rns`` takes. The (T, 1) reshape
+    of the scalar redundant-channel entries lives here and only here."""
+    stack = lambda i: np.stack([np.asarray(r[i]) for r in rows])
+    t = len(rows)
+    return (
+        stack(0),
+        stack(1).reshape(t, 1),
+        stack(2),
+        stack(3),
+        stack(4),
+        stack(5).reshape(t, 1),
+    )
+
+
+def assemble_key_rows(ns: list[int]):
+    """Stack cached per-key rows for a batch of moduli, or None if any
+    modulus is RNS-incapable (caller falls back for those)."""
+    ctx = context()
+    rows = []
+    for n in ns:
+        r = ctx.key_rows(n)
+        if r is None:
+            return None
+        rows.append(r)
+    return stack_key_rows(rows)

@@ -124,10 +124,20 @@ class _DaemonPool:
         self._max = max_workers
 
     def submit(self, fn: Callable[[], None]) -> None:
+        # Reserve a worker *at submit time*: either claim an idle one or
+        # spawn. Without the reservation, a burst of submits all observe
+        # the same not-yet-woken idle worker and pile onto one thread —
+        # serializing the fan-out and, for nested multicasts, queueing a
+        # task behind the very worker that waits on it.
         with self._lock:
-            spawn = self._idle == 0 and self._count < self._max
-            if spawn:
+            if self._idle > 0:
+                self._idle -= 1
+                spawn = False
+            elif self._count < self._max:
                 self._count += 1
+                spawn = True
+            else:
+                spawn = False  # cap: task waits for the next free worker
         self._q.put(fn)
         if spawn:
             threading.Thread(
@@ -136,15 +146,13 @@ class _DaemonPool:
 
     def _worker(self) -> None:
         while True:
-            with self._lock:
-                self._idle += 1
             fn = self._q.get()
-            with self._lock:
-                self._idle -= 1
             try:
                 fn()
             except Exception:  # workers must survive any task error
                 pass
+            with self._lock:
+                self._idle += 1
 
 
 _pool = _DaemonPool()
