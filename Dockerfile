@@ -1,0 +1,31 @@
+# Containerized cluster (reference: Dockerfile + scripts/run.sh — one
+# process per key dir with sequential ports).
+#
+#   docker build -t bftkv-tpu .
+#   docker run -p 7001-7008:7001-7008 bftkv-tpu
+#
+# The image generates a fresh 4+4 universe at build time and runs one
+# daemon per home dir; override CMD to mount real keys instead. JAX
+# runs on CPU inside the container — the verify/sign dispatchers are
+# opt-in (--dispatch) and belong on accelerator-backed replicas.
+
+FROM python:3.12-slim
+
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        g++ make && rm -rf /var/lib/apt/lists/*
+RUN pip install --no-cache-dir "jax[cpu]" cryptography numpy
+
+WORKDIR /app
+COPY bftkv_tpu ./bftkv_tpu
+COPY native ./native
+COPY visual ./visual
+RUN make -C native
+
+ENV JAX_PLATFORMS=cpu PYTHONPATH=/app
+RUN python -m bftkv_tpu.cmd.genkeys --out /keys --servers 4 --rw 4 \
+        --users 1 --base-port 7001 --rw-base-port 7101
+
+EXPOSE 7001-7008 7101-7108 7501-7508
+CMD ["python", "-m", "bftkv_tpu.cmd.run_cluster", \
+     "--keys", "/keys", "--db-root", "/data", "--storage", "native", \
+     "--api-base", "7501", "--client-home", "/keys/u01"]

@@ -76,6 +76,78 @@ def _value_arg(v: str | None) -> bytes:
     return v.encode()
 
 
+# -- X.509 threshold signing (reference: bftrw.go:211-302) ----------------
+
+
+def _der_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    body = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([0x80 | len(body)]) + body
+
+
+def _der_children(data: bytes) -> list[bytes]:
+    """Top-level TLV elements of a DER SEQUENCE body (full encodings)."""
+    out, off = [], 0
+    while off < len(data):
+        start = off
+        off += 1  # tag (all tags we meet are single-byte)
+        ln = data[off]
+        off += 1
+        if ln & 0x80:
+            nbytes = ln & 0x7F
+            ln = int.from_bytes(data[off : off + nbytes], "big")
+            off += nbytes
+        off += ln
+        out.append(data[start:off])
+    return out
+
+
+def threshold_sign_x509(a, caname: str, der: bytes) -> bytes:
+    """Re-sign an X.509 template certificate with the threshold CA and
+    return the assembled DER (reference: bftrw.go:216-302 — the
+    template's TBS is threshold-signed and the certificate rebuilt as
+    SEQUENCE{tbs, signatureAlgorithm, BIT STRING}).
+    """
+    from cryptography import x509
+    from cryptography.x509.oid import SignatureAlgorithmOID as OID
+
+    crt = x509.load_der_x509_certificate(der)
+    oid = crt.signature_algorithm_oid
+    algos = {
+        OID.RSA_WITH_SHA256: ("rsa", "sha256"),
+        OID.RSA_WITH_SHA384: ("rsa", "sha384"),
+        OID.RSA_WITH_SHA512: ("rsa", "sha512"),
+        OID.ECDSA_WITH_SHA256: ("ecdsa", "sha256"),
+        OID.ECDSA_WITH_SHA384: ("ecdsa", "sha384"),
+        OID.ECDSA_WITH_SHA512: ("ecdsa", "sha512"),
+    }
+    if oid not in algos:
+        raise SystemExit(f"unsupported signature algorithm {oid}")
+    algo_name, hash_name = algos[oid]
+
+    sig = a.sign(caname, crt.tbs_certificate_bytes, _algo(algo_name), hash_name)
+    if algo_name == "ecdsa":
+        # Our threshold ECDSA yields raw r||s; X.509 carries DER
+        # ECDSA-Sig-Value.
+        from cryptography.hazmat.primitives.asymmetric.utils import (
+            encode_dss_signature,
+        )
+
+        half = len(sig) // 2
+        sig = encode_dss_signature(
+            int.from_bytes(sig[:half], "big"),
+            int.from_bytes(sig[half:], "big"),
+        )
+
+    outer = _der_children(der)[0]  # the Certificate SEQUENCE
+    hdr = 2 if outer[1] < 0x80 else 2 + (outer[1] & 0x7F)
+    tbs_b, sigalg_b, _old_sig = _der_children(outer[hdr:])
+    bitstring = b"\x03" + _der_len(len(sig) + 1) + b"\x00" + sig
+    body = tbs_b + sigalg_b + bitstring
+    return b"\x30" + _der_len(len(body)) + body
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description="bftkv user tool")
     ap.add_argument("--home", required=True)
@@ -105,6 +177,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--algo", choices=["rsa", "dsa", "ecdsa"], default="rsa")
     p.add_argument("--hash", dest="hash_name", default="sha256")
     p.add_argument("--out", default="", help="signature output (default stdout)")
+
+    p = sub.add_parser("signx509")
+    p.add_argument("caname")
+    p.add_argument("--cert", required=True,
+                   help="template certificate (PEM or DER); its TBS is "
+                        "threshold-signed by the CA")
+    p.add_argument("--out", default="", help="output file (default stdout PEM)")
+    p.add_argument("--no-store", action="store_true",
+                   help="skip storing the cert under its SubjectKeyId")
 
     p = sub.add_parser("kms")
     p.add_argument("caname")
@@ -150,6 +231,33 @@ def main(argv: list[str] | None = None) -> int:
                 f.write(sig)
         else:
             sys.stdout.buffer.write(sig)
+    elif args.cmd == "signx509":
+        from cryptography import x509 as _x509
+        from cryptography.hazmat.primitives import serialization as _ser
+
+        with open(args.cert, "rb") as f:
+            data = f.read()
+        if b"-----BEGIN" in data:
+            data = _x509.load_pem_x509_certificate(data).public_bytes(
+                _ser.Encoding.DER
+            )
+        out_der = threshold_sign_x509(a, args.caname, data)
+        crt = _x509.load_der_x509_certificate(out_der)
+        if not args.no_store:
+            # Register under the SubjectKeyId (reference: bftrw.go:293).
+            try:
+                ski = crt.extensions.get_extension_for_class(
+                    _x509.SubjectKeyIdentifier
+                ).value.digest
+                a.write(ski, out_der)
+            except _x509.ExtensionNotFound:
+                print("no SubjectKeyId extension; not stored", file=sys.stderr)
+        pem = crt.public_bytes(_ser.Encoding.PEM)
+        if args.out:
+            with open(args.out, "wb") as f:
+                f.write(pem)
+        else:
+            sys.stdout.buffer.write(pem)
     elif args.cmd == "kms":
         # Random name + random key, stored password-protected
         # (reference: bftrw.go:272-316).
