@@ -25,7 +25,11 @@ ENV JAX_PLATFORMS=cpu PYTHONPATH=/app
 RUN python -m bftkv_tpu.cmd.genkeys --out /keys --servers 4 --rw 4 \
         --users 1 --base-port 7001 --rw-base-port 7101
 
+# Certificates carry 127.0.0.1 dial addresses (valid inside the
+# container); --bind-host/--api-host open the listen sockets on all
+# interfaces so published ports are reachable from the host.
 EXPOSE 7001-7008 7101-7108 7501-7508
 CMD ["python", "-m", "bftkv_tpu.cmd.run_cluster", \
      "--keys", "/keys", "--db-root", "/data", "--storage", "native", \
-     "--api-base", "7501", "--client-home", "/keys/u01"]
+     "--api-base", "7501", "--client-home", "/keys/u01", \
+     "--bind-host", "0.0.0.0", "--api-host", "0.0.0.0"]

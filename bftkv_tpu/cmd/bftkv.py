@@ -215,6 +215,11 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--ws", default="",
                     help="WebSocket visualizer feed addr host:port "
                          "(view at /visual on the client API)")
+    ap.add_argument("--bind-host", default="",
+                    help="listen on this host instead of the certificate "
+                         "address's host (containers: 0.0.0.0 so published "
+                         "ports are reachable while peers still dial the "
+                         "certificate address)")
     ap.add_argument("--join", action="store_true",
                     help="crawl the trust graph at startup")
     ap.add_argument("--dispatch", action="store_true",
@@ -234,8 +239,17 @@ def main(argv: list[str] | None = None) -> int:
         dispatch.install()
         dispatch.install_signer()
 
-    server.start()
-    print(f"bftkv: serving {graph.name} @ {graph.address}", flush=True)
+    if args.bind_host:
+        # Listen-side override only; the certificate address stays the
+        # dial address for peers.
+        addr = graph.address.split("://", 1)[-1]
+        port = addr.rsplit(":", 1)[-1]
+        server.tr.start(server, f"{args.bind_host}:{port}")
+        print(f"bftkv: serving {graph.name} @ {args.bind_host}:{port} "
+              f"(cert addr {graph.address})", flush=True)
+    else:
+        server.start()
+        print(f"bftkv: serving {graph.name} @ {graph.address}", flush=True)
 
     from bftkv_tpu.protocol.client import Client
 

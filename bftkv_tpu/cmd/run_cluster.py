@@ -39,6 +39,8 @@ def spawn(
     *,
     storage: str = "plain",
     api_base: int = 0,
+    api_host: str = "127.0.0.1",
+    bind_host: str = "",
     join: bool = False,
     client_home: str = "",
     extra_env: dict | None = None,
@@ -56,9 +58,11 @@ def spawn(
             "--revlist", os.path.join(db_root, name + ".rev"),
         ]
         if api_base:
-            cmd += ["--api", f"127.0.0.1:{api_base + i}"]
+            cmd += ["--api", f"{api_host}:{api_base + i}"]
             if client_home:
                 cmd += ["--client-home", client_home]
+        if bind_host:
+            cmd += ["--bind-host", bind_host]
         if join:
             cmd += ["--join"]
         procs.append(subprocess.Popen(cmd, env=env))
@@ -87,6 +91,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="client API port for the first server, +1 each")
     ap.add_argument("--client-home", default="",
                     help="user home the client APIs act as (see bftkv --help)")
+    ap.add_argument("--api-host", default="127.0.0.1",
+                    help="interface the client APIs listen on")
+    ap.add_argument("--bind-host", default="",
+                    help="protocol listen interface override (containers: "
+                         "0.0.0.0)")
     args = ap.parse_args(argv)
 
     homes = server_homes(args.keys)
@@ -94,7 +103,8 @@ def main(argv: list[str] | None = None) -> int:
         print(f"no server homes under {args.keys}", file=sys.stderr)
         return 1
     procs = spawn(homes, args.db_root, storage=args.storage,
-                  api_base=args.api_base, client_home=args.client_home)
+                  api_base=args.api_base, api_host=args.api_host,
+                  bind_host=args.bind_host, client_home=args.client_home)
     print(f"run_cluster: {len(procs)} servers up", flush=True)
 
     stopping = False

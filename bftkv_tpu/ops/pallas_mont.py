@@ -113,7 +113,6 @@ def _make_mont_mul(n, nprime, n2):
 
     ``n``/``nprime`` are (T, 128); ``n2`` is n padded to (T, 256).
     """
-    lane0 = None
 
     def mont_mul(a, b2):
         """REDC: a·b·R⁻¹ mod n.  ``a`` (T,128) digits, ``b2`` (T,256)
