@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import signal
 import sys
 import threading
@@ -157,6 +158,43 @@ class _ApiHandler(BaseHTTPRequestHandler):
                 )
                 with open(page, "rb") as f:
                     self._reply(200, f.read(), "text/html")
+            elif path.startswith("/debug/profile"):
+                # TPU/XLA trace capture (stands in for the reference's
+                # pprof endpoint, cmd/bftkv/main.go:20,253): collects a
+                # jax profiler trace viewable in TensorBoard/Perfetto.
+                # The output location is confined to a fixed root — the
+                # API may be exposed beyond localhost.
+                import re as _re
+                import tempfile as _tf
+                import time as _time
+                import urllib.parse as _up
+
+                q = _up.parse_qs(_up.urlparse(path).query)
+                try:
+                    seconds = float(q.get("seconds", ["2"])[0])
+                except ValueError:
+                    seconds = 2.0
+                if not (seconds >= 0.0):  # also catches NaN
+                    seconds = 0.0
+                seconds = min(seconds, 30.0)
+                name = _re.sub(
+                    r"[^A-Za-z0-9_.-]", "_", q.get("name", ["trace"])[0]
+                )[:64]
+                outdir = os.path.join(
+                    _tf.gettempdir(), "bftkv-profile", name
+                )
+                import jax
+
+                jax.profiler.start_trace(outdir)
+                try:
+                    _time.sleep(seconds)
+                finally:
+                    jax.profiler.stop_trace()
+                self._reply(
+                    200,
+                    f"trace captured to {outdir}\n".encode(),
+                    "text/plain",
+                )
             elif path == "/metrics":
                 from bftkv_tpu.metrics import registry as metrics
 
@@ -239,17 +277,13 @@ def main(argv: list[str] | None = None) -> int:
         dispatch.install()
         dispatch.install_signer()
 
-    if args.bind_host:
-        # Listen-side override only; the certificate address stays the
-        # dial address for peers.
-        addr = graph.address.split("://", 1)[-1]
-        port = addr.rsplit(":", 1)[-1]
-        server.tr.start(server, f"{args.bind_host}:{port}")
-        print(f"bftkv: serving {graph.name} @ {args.bind_host}:{port} "
-              f"(cert addr {graph.address})", flush=True)
-    else:
-        server.start()
-        print(f"bftkv: serving {graph.name} @ {graph.address}", flush=True)
+    server.start(bind_host=args.bind_host)
+    where = (
+        f"{args.bind_host} (cert addr {graph.address})"
+        if args.bind_host
+        else graph.address
+    )
+    print(f"bftkv: serving {graph.name} @ {where}", flush=True)
 
     from bftkv_tpu.protocol.client import Client
 

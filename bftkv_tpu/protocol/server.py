@@ -75,11 +75,16 @@ class Server(Protocol):
 
     # -- lifecycle (reference: server.go:47-62) ---------------------------
 
-    def start(self) -> None:
+    def start(self, bind_host: str = "") -> None:
+        """``bind_host`` overrides the listen interface (containers:
+        0.0.0.0) while peers keep dialing the certificate address."""
         addr = self.self_node.address
         if addr:
-            self.tr.start(self, _listen_addr(addr))
-            log.info("server @ %s running", addr)
+            listen = _listen_addr(addr)
+            if bind_host:
+                listen = f"{bind_host}:{listen.rsplit(':', 1)[-1]}"
+            self.tr.start(self, listen)
+            log.info("server @ %s running (listen %s)", addr, listen)
 
     def stop(self) -> None:
         self.leaving()

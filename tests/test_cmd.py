@@ -10,6 +10,7 @@ import os
 import subprocess
 import sys
 import time
+import urllib.parse
 import urllib.request
 
 import pytest
@@ -119,6 +120,25 @@ def test_daemon_show_and_metrics(cluster):
 
         snap = json.loads(res.read())
     assert isinstance(snap, dict)
+
+
+def test_daemon_profile_endpoint(cluster):
+    """The jax-profiler trace endpoint (pprof analog,
+    reference: cmd/bftkv/main.go:20,253) captures a trace directory
+    confined under the fixed profile root."""
+    import tempfile
+
+    outdir = os.path.join(tempfile.gettempdir(), "bftkv-profile", "smoke")
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{API_BASE}/debug/profile?seconds=0.2&name=smoke",
+        timeout=90,
+    ) as res:
+        assert b"trace captured" in res.read()
+    found = []
+    for root, _dirs, files in os.walk(outdir):
+        found += [f for f in files if f.endswith(".trace.json.gz")
+                  or "xplane" in f or f.endswith(".pb")]
+    assert found, f"no trace artifacts under {outdir}"
 
 
 def test_daemon_api_missing_variable(cluster):
