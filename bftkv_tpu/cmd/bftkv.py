@@ -180,6 +180,10 @@ class _ApiHandler(BaseHTTPRequestHandler):
                 name = _re.sub(
                     r"[^A-Za-z0-9_.-]", "_", q.get("name", ["trace"])[0]
                 )[:64]
+                # "", "." and ".." survive the character filter but
+                # escape (or collapse into) the confinement root.
+                if name in ("", ".", ".."):
+                    name = "trace"
                 outdir = os.path.join(
                     _tf.gettempdir(), "bftkv-profile", name
                 )

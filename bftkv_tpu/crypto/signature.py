@@ -75,21 +75,36 @@ class Signer:
         self.cert = certificate
 
     def issue(self, tbs: bytes, *, include_cert: bool = True) -> SignaturePacket:
-        # Route through the cross-request sign dispatcher when one is
-        # installed: concurrent handlers' share issuance then batches
-        # into shared CRT-modexp launches and stops serializing on the
-        # GIL (host pow does not release it).
+        return self.issue_many([tbs], include_cert=include_cert)[0]
+
+    def issue_many(
+        self, tbs_list: list[bytes], *, include_cert: bool = True
+    ) -> list[SignaturePacket]:
+        """Batch of detached signatures in ONE dispatcher submission.
+
+        When a cross-request sign dispatcher is installed, concurrent
+        handlers' share issuance batches into shared CRT-modexp
+        launches and stops serializing on the GIL (host ``pow`` does
+        not release it); without one, signing falls back to host.
+        ``issue`` is the one-item form."""
         from bftkv_tpu.ops import dispatch
 
         d = dispatch.get_signer()
-        sig = d.sign(tbs, self.key) if d is not None else rsa.sign(tbs, self.key)
-        return SignaturePacket(
-            type=SIGNATURE_TYPE_NATIVE,
-            version=1,
-            completed=True,
-            data=serialize_entries([(self.cert.id, sig)]),
-            cert=self.cert.serialize() if include_cert else None,
-        )
+        if d is not None:
+            sigs = d.submit([(tbs, self.key) for tbs in tbs_list])
+        else:
+            sigs = [rsa.sign(tbs, self.key) for tbs in tbs_list]
+        cert_bytes = self.cert.serialize() if include_cert else None
+        return [
+            SignaturePacket(
+                type=SIGNATURE_TYPE_NATIVE,
+                version=1,
+                completed=True,
+                data=serialize_entries([(self.cert.id, sig)]),
+                cert=cert_bytes,
+            )
+            for sig in sigs
+        ]
 
 
 def _resolve_cert(
@@ -131,39 +146,64 @@ class CollectiveSignature:
         """Raise unless enough *distinct, quorum-member* signers verify.
 
         One TPU batch over every entry — all signatures verify in a
-        single kernel launch.
+        single kernel launch.  (One-job form of :meth:`verify_many`, so
+        the single and batch write paths share one semantics.)
         """
-        try:
-            entries = parse_entries(ss.data if ss else None)
-            embedded = _embedded_certs(ss) if ss else {}
-        except Exception:
-            # Hostile packet bytes (torn entries, junk certs) are an
-            # invalid signature, never an unhandled exception.
-            raise ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES from None
-        items: list[tuple[bytes, bytes, rsa.PublicKey]] = []
-        certs: list[certmod.Certificate] = []
-        for signer_id, sig in entries:
-            c = _resolve_cert(signer_id, keyring, embedded)
-            if c is None:
-                continue
-            items.append((tbss, sig, c.public_key))
-            certs.append(c)
-        if not items:
-            raise ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES
-        # Route through the cross-request batching dispatcher when one
-        # is installed: concurrent server handlers then share device
-        # launches (SURVEY §7 phase 5).
+        err = self.verify_many([(tbss, ss)], quorum, keyring)[0]
+        if err is not None:
+            raise err
+
+    def verify_many(
+        self,
+        jobs: list[tuple[bytes, SignaturePacket | None]],
+        quorum,
+        keyring,
+    ) -> list[Exception | type | None]:
+        """Batched form of :meth:`verify` for the batch write pipeline:
+        every entry of every job rides in ONE device batch; returns one
+        error (or ``None``) per job instead of raising."""
         from bftkv_tpu.ops import dispatch
 
-        d = dispatch.get()
-        ok = (
-            d.verify(items)
-            if d is not None
-            else self.verifier.verify_batch(items)
-        )
-        valid = {c for c, good in zip(certs, ok) if good}
-        if not quorum.is_sufficient(list(valid)):
-            raise ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES
+        results: list[Exception | type | None] = [None] * len(jobs)
+        items: list[tuple[bytes, bytes, rsa.PublicKey]] = []
+        spans: list[tuple[int, list[certmod.Certificate]]] = []
+        for j, (tbss, ss) in enumerate(jobs):
+            certs: list[certmod.Certificate] = []
+            start = len(items)
+            try:
+                entries = parse_entries(ss.data if ss else None)
+                embedded = _embedded_certs(ss) if ss else {}
+                for signer_id, sig in entries:
+                    c = _resolve_cert(signer_id, keyring, embedded)
+                    if c is None:
+                        continue
+                    items.append((tbss, sig, c.public_key))
+                    certs.append(c)
+            except Exception:
+                results[j] = ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES
+                spans.append((start, []))
+                continue
+            spans.append((start, certs))
+            if not certs:
+                results[j] = ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES
+        if items:
+            d = dispatch.get()
+            ok = (
+                d.verify(items)
+                if d is not None
+                else self.verifier.verify_batch(items)
+            )
+        else:
+            ok = []
+        for j, (start, certs) in enumerate(spans):
+            if results[j] is not None:
+                continue
+            valid = {
+                c for c, good in zip(certs, ok[start : start + len(certs)]) if good
+            }
+            if not quorum.is_sufficient(list(valid)):
+                results[j] = ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES
+        return results
 
     def sign(
         self, signer: Signer, tbss: bytes, *, completed: bool = False

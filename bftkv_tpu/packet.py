@@ -256,6 +256,59 @@ def parse_auth_request(pkt: bytes) -> tuple[int, bytes | None, bytes | None]:
     return phase, variable, adata
 
 
+def serialize_list(items: list[bytes]) -> bytes:
+    """Count-prefixed list of chunks — the payload of the batch commands
+    (no reference analog; the reference carries one request per round)."""
+    buf = io.BytesIO()
+    buf.write(struct.pack(">I", len(items)))
+    for it in items:
+        write_chunk(buf, it)
+    return buf.getvalue()
+
+
+def parse_list(data: bytes) -> list[bytes]:
+    r = io.BytesIO(data)
+    hdr = r.read(4)
+    if len(hdr) < 4:
+        raise ERR_MALFORMED_REQUEST
+    (count,) = struct.unpack(">I", hdr)
+    # Each item needs at least an 8-byte length header after the count.
+    if count > (len(data) - 4) // 8:
+        raise ERR_MALFORMED_REQUEST
+    out: list[bytes] = []
+    for _ in range(count):
+        try:
+            out.append(read_chunk(r) or b"")
+        except EOFError:
+            raise ERR_MALFORMED_REQUEST from None
+    return out
+
+
+def serialize_results(results: list[tuple[str | None, bytes]]) -> bytes:
+    """Per-item outcomes of a batch command: ``(error_message | None,
+    payload)`` per item.  Error strings round-trip through the interned
+    error registry exactly like the x-error header does."""
+    items = []
+    for err, payload in results:
+        if err is None:
+            items.append(b"\x00" + payload)
+        else:
+            items.append(b"\x01" + err.encode())
+    return serialize_list(items)
+
+
+def parse_results(data: bytes) -> list[tuple[str | None, bytes]]:
+    out: list[tuple[str | None, bytes]] = []
+    for it in parse_list(data):
+        if not it:
+            raise ERR_MALFORMED_REQUEST
+        if it[0] == 0:
+            out.append((None, it[1:]))
+        else:
+            out.append((it[1:].decode(errors="replace"), b""))
+    return out
+
+
 def write_bigint(buf: io.BytesIO, n: int | None) -> None:
     """(reference: packet/packet.go:288-294)"""
     if n is None:

@@ -25,9 +25,11 @@ from bftkv_tpu import packet as pkt
 from bftkv_tpu import quorum as qm
 from bftkv_tpu import transport as tp
 from bftkv_tpu.crypto import auth as authmod
+from bftkv_tpu.crypto import cert as certmod
 from bftkv_tpu.crypto import signature as sigmod
 from bftkv_tpu.crypto.threshold import ThresholdAlgo, serialize_params
 from bftkv_tpu.errors import (
+    error_from_string,
     ERR_CONTINUE,
     ERR_INSUFFICIENT_NUMBER_OF_QUORUM,
     ERR_INSUFFICIENT_NUMBER_OF_RESPONSES,
@@ -35,6 +37,7 @@ from bftkv_tpu.errors import (
     ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES,
     ERR_INSUFFICIENT_NUMBER_OF_VALID_RESPONSES,
     ERR_INVALID_TIMESTAMP,
+    ERR_MALFORMED_REQUEST,
     ERR_NO_AUTHENTICATION_DATA,
 )
 from bftkv_tpu.metrics import registry as metrics
@@ -59,6 +62,109 @@ class _SignedValue:
 class _InProgress(Exception):
     """Internal sentinel: no bucket has reached threshold yet
     (reference: errInProgress, client.go:179)."""
+
+
+#: Neutral per-item outcome: the response neither advances the item's
+#: quorum count nor counts as a failure (e.g. a sign share whose signer
+#: the client cannot resolve — the single path's combine() likewise
+#: keeps waiting without charging the server as failed).
+_SKIP = object()
+
+
+class _BatchTally:
+    """Per-item quorum accounting for one batched multicast.
+
+    A server that succeeds on *every* item lands in one shared list, so
+    the common case costs a single predicate test per response; per-item
+    lists exist only for the (rare) items some server failed or skipped.
+    Because ``all_ok`` only holds servers that succeeded on every item,
+    it is a subset of every item's ok-set — one passing test covers the
+    batch.
+    """
+
+    def __init__(self, n: int, predicate, reject):
+        self.n = n
+        self.predicate = predicate  # is_threshold / is_sufficient
+        self.reject = reject
+        self.all_ok: list = []
+        self.partial: dict[int, list] = {}
+        self.fails: dict[int, list] = {}  # i -> [(peer, err)]
+        self.done = [False] * n
+        self.rejected: list[Exception | None] = [None] * n
+
+    def record(self, peer, per_item_err: list) -> bool:
+        """One server's per-item outcomes (``None`` ok, ``_SKIP``
+        neutral, exception failed); True = stop the multicast."""
+        if all(e is None for e in per_item_err):
+            self.all_ok.append(peer)
+        else:
+            for i, e in enumerate(per_item_err):
+                if e is None:
+                    self.partial.setdefault(i, []).append(peer)
+                elif e is not _SKIP:
+                    self.fails.setdefault(i, []).append((peer, e))
+        return self._update()
+
+    def fail_server(self, peer, err: Exception | None) -> bool:
+        """The whole response failed (transport error, bad codec)."""
+        for i in range(self.n):
+            self.fails.setdefault(i, []).append((peer, err))
+        return self._update()
+
+    def _update(self) -> bool:
+        if self.predicate(self.all_ok):
+            for i in range(self.n):
+                self.done[i] = True
+        else:
+            for i, extra in self.partial.items():
+                if not self.done[i]:
+                    self.done[i] = self.predicate(self.all_ok + extra)
+            for i, fl in self.fails.items():
+                if not self.done[i] and self.rejected[i] is None:
+                    if self.reject([p for p, _ in fl]):
+                        self.rejected[i] = majority_error(
+                            [e for _, e in fl if e is not None],
+                            ERR_INSUFFICIENT_NUMBER_OF_VALID_RESPONSES,
+                        )
+        return all(
+            self.done[i] or self.rejected[i] is not None for i in range(self.n)
+        )
+
+    def item_error(self, i: int, insufficient) -> Exception | None:
+        """Final per-item outcome after the fan-out completed."""
+        if self.done[i]:
+            return None
+        if self.rejected[i] is not None:
+            return self.rejected[i]
+        return majority_error(
+            [e for _, e in self.fails.get(i, []) if e is not None], insufficient
+        )
+
+
+def _batch_cb(tally: _BatchTally, expected: int, per_item_fn):
+    """The response-envelope handling shared by the three batch phases:
+    transport errors, result-codec errors, and length mismatches are
+    whole-server failures; ``per_item_fn(k, payload)`` maps one decoded
+    ok-payload to ``None`` / ``_SKIP`` / an exception."""
+
+    def cb(res: tp.MulticastResponse) -> bool:
+        if res.err is not None or res.data is None:
+            return tally.fail_server(res.peer, res.err)
+        try:
+            out = pkt.parse_results(res.data)
+            if len(out) != expected:
+                raise ERR_MALFORMED_REQUEST
+        except Exception as e:
+            return tally.fail_server(res.peer, e)
+        per_item = [
+            error_from_string(errstr)
+            if errstr is not None
+            else per_item_fn(k, payload)
+            for k, (errstr, payload) in enumerate(out)
+        ]
+        return tally.record(res.peer, per_item)
+
+    return cb
 
 
 class Client(Protocol):
@@ -162,6 +268,178 @@ class Client(Protocol):
         except Exception as e:
             raise majority_error(errs, e)
         return sig, ss
+
+    # -- batched write pipeline (no reference analog) ---------------------
+
+    def write_many(
+        self, items: list[tuple[bytes, bytes]], proof=None
+    ) -> list[Exception | None]:
+        """Batched three-phase signed write of B *distinct* variables.
+
+        Same per-item semantics as ``write`` — every item independently
+        passes the timestamp, quorum-certificate, equivocation, TOFU,
+        and collective-signature checks on every replica — but the three
+        phases each cross the network once for the whole batch, and
+        every signature operation (client TBS signing, server writer-sig
+        verification, server share issuance, collective verification)
+        runs as one device batch instead of B×n individual calls.
+
+        Returns a list aligned with ``items``: ``None`` per success, the
+        per-item error otherwise.
+        """
+        if not items:
+            return []
+        variables = [v for v, _ in items]
+        if len(set(variables)) != len(variables):
+            # Duplicates in one batch would equivocate against each
+            # other at the same timestamp; that is a caller bug.
+            raise ValueError("write_many: duplicate variables in one batch")
+        n = len(items)
+        results: list[Exception | None] = [None] * n
+
+        with metrics.timer("client.write_many.latency"):
+            # ---- phase 1: timestamps (reference: client.go:62-92) ----
+            qr = self.qs.choose_quorum(qm.READ | qm.AUTH)
+            maxts = [0] * n
+            tally = _BatchTally(n, qr.is_threshold, qr.reject)
+
+            def on_time(i: int, payload: bytes):
+                if len(payload) > 8:
+                    return ERR_INVALID_TIMESTAMP
+                t = int.from_bytes(payload, "big")
+                if t > maxts[i]:
+                    maxts[i] = t
+                return None
+
+            self.tr.multicast(
+                tp.BATCH_TIME,
+                qr.nodes(),
+                pkt.serialize_list(variables),
+                _batch_cb(tally, n, on_time),
+            )
+            for i in range(n):
+                err = tally.item_error(i, ERR_INSUFFICIENT_NUMBER_OF_QUORUM)
+                if err is not None:
+                    results[i] = err
+                elif maxts[i] == MAX_UINT64:
+                    results[i] = ERR_INVALID_TIMESTAMP
+
+            # ---- phase 2: sign (reference: client.go:125-170) --------
+            pending = [i for i in range(n) if results[i] is None]
+            if not pending:
+                return results
+            ts = {i: maxts[i] + 1 for i in pending}
+            tbs_list = [
+                pkt.serialize(items[i][0], items[i][1], ts[i], nfields=3)
+                for i in pending
+            ]
+            sigs = dict(zip(pending, self.crypt.signer.issue_many(tbs_list)))
+            reqs = [
+                pkt.serialize(items[i][0], items[i][1], ts[i], sigs[i], proof)
+                for i in pending
+            ]
+
+            qa = self.qs.choose_quorum(qm.AUTH | qm.PEER)
+            entries: dict[int, dict[int, bytes]] = {i: {} for i in pending}
+            extra_certs: dict[int, object] = {}  # embedded, not in keyring
+            stally = _BatchTally(len(pending), qa.is_sufficient, qa.reject)
+
+            def on_share(k: int, payload: bytes):
+                # Count only shares whose signer RESOLVES — sufficiency
+                # must track usable signatures, not responding servers,
+                # or an unresolvable (Byzantine) share would stop the
+                # fan-out early and starve verification below quorum.
+                try:
+                    share = pkt.parse_signature(payload)
+                    if share is not None and share.cert:
+                        for c in certmod.parse(share.cert):
+                            if self.crypt.keyring.get(c.id) is None:
+                                extra_certs.setdefault(c.id, c)
+                    added = False
+                    for sid, sb in sigmod.parse_entries(
+                        share.data if share else None
+                    ):
+                        if (
+                            self.crypt.keyring.get(sid) is not None
+                            or sid in extra_certs
+                        ):
+                            entries[pending[k]].setdefault(sid, sb)
+                            added = True
+                    return None if added else _SKIP
+                except Exception as e:
+                    return e
+
+            self.tr.multicast(
+                tp.BATCH_SIGN,
+                qa.nodes(),
+                pkt.serialize_list(reqs),
+                _batch_cb(stally, len(pending), on_share),
+            )
+            jobs: list[tuple[bytes, pkt.SignaturePacket]] = []
+            jidx: list[int] = []
+            sss: dict[int, pkt.SignaturePacket] = {}
+            for k, i in enumerate(pending):
+                err = stally.item_error(
+                    k, ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES
+                )
+                if err is not None:
+                    results[i] = err
+                    continue
+                embeds = [
+                    extra_certs[sid]
+                    for sid in entries[i]
+                    if sid in extra_certs
+                ]
+                ss = pkt.SignaturePacket(
+                    type=pkt.SIGNATURE_TYPE_NATIVE,
+                    version=1,
+                    completed=True,
+                    data=sigmod.serialize_entries(list(entries[i].items())),
+                    cert=certmod.serialize_many(embeds) if embeds else None,
+                )
+                sss[i] = ss
+                tbss = pkt.serialize(
+                    items[i][0], items[i][1], ts[i], sigs[i], nfields=4
+                )
+                jobs.append((tbss, ss))
+                jidx.append(i)
+            if jobs:
+                verrs = self.crypt.collective.verify_many(
+                    jobs, qa, self.crypt.keyring
+                )
+                for j, i in enumerate(jidx):
+                    if verrs[j] is not None:
+                        results[i] = verrs[j]
+
+            # ---- phase 3: write (reference: client.go:94-121) --------
+            pending = [i for i in range(n) if results[i] is None]
+            if not pending:
+                return results
+            data = [
+                pkt.serialize(
+                    items[i][0], items[i][1], ts[i], sigs[i], sss[i]
+                )
+                for i in pending
+            ]
+            qw = self.qs.choose_quorum(qm.WRITE)
+            wtally = _BatchTally(len(pending), qw.is_threshold, qw.reject)
+            self.tr.multicast(
+                tp.BATCH_WRITE,
+                qw.nodes(),
+                pkt.serialize_list(data),
+                _batch_cb(wtally, len(pending), lambda k, payload: None),
+            )
+            nok = 0
+            for k, i in enumerate(pending):
+                err = wtally.item_error(
+                    k, ERR_INSUFFICIENT_NUMBER_OF_RESPONSES
+                )
+                if err is not None:
+                    results[i] = err
+                else:
+                    nok += 1
+            metrics.incr("client.write.ok", nok)
+            return results
 
     # -- read path (reference: client.go:189-353) -------------------------
 

@@ -45,6 +45,7 @@ from bftkv_tpu.errors import (
     ERR_EXIST,
     ERR_INVALID_QUORUM_CERTIFICATE,
     ERR_INVALID_SIGN_REQUEST,
+    ERR_INVALID_SIGNATURE,
     ERR_INVALID_USER_ID,
     ERR_MALFORMED_REQUEST,
     ERR_NO_AUTHENTICATION_DATA,
@@ -235,9 +236,24 @@ class Server(Protocol):
         issuer = sigmod.issuer(sig, self.crypt.keyring)
         tbs = pkt.tbs(req)
         sigmod.verify_with_certificate(tbs, sig, issuer)
+        self._check_quorum_certificate(issuer)
 
-        # Quorum certificate: the writer's certificate must be signed by
-        # a CERT-quorum threshold (reference: server.go:211-214).
+        proof = self._sign_storage_checks(variable, val, t, sig, ss)
+
+        tbss = pkt.tbss(req)
+        share = self.crypt.collective.sign(self.crypt.signer, tbss)
+        res = pkt.serialize_signature(share)
+
+        # Persist the request *without* ss — marks the write in-progress
+        # (reference: server.go:275-281).
+        stored = pkt.serialize(variable, val, t, sig, None, proof)
+        self.storage.write(variable, t, stored)
+        metrics.incr("server.sign.ok")
+        return res
+
+    def _check_quorum_certificate(self, issuer) -> None:
+        """The writer's certificate must be signed by a CERT-quorum
+        threshold (reference: server.go:211-214)."""
         q = self.qs.choose_quorum(qm.AUTH | qm.CERT)
         signer_nodes = [
             c
@@ -247,6 +263,11 @@ class Server(Protocol):
         if not q.is_threshold(signer_nodes):
             raise ERR_INVALID_QUORUM_CERTIFICATE
 
+    def _sign_storage_checks(self, variable, val, t, sig, ss):
+        """The per-variable part of ``sign``: TPA proof, write-once,
+        equivocation, and timestamp checks against the stored version
+        (reference: server.go:232-262).  Returns the auth params to
+        inherit into the persisted record."""
         rdata = None
         try:
             rdata = self.storage.read(variable, 0)
@@ -283,17 +304,7 @@ class Server(Protocol):
             if t < rp.t:
                 raise ERR_BAD_TIMESTAMP
             proof = rp.auth  # inherit the auth params
-
-        tbss = pkt.tbss(req)
-        share = self.crypt.collective.sign(self.crypt.signer, tbss)
-        res = pkt.serialize_signature(share)
-
-        # Persist the request *without* ss — marks the write in-progress
-        # (reference: server.go:275-281).
-        stored = pkt.serialize(variable, val, t, sig, None, proof)
-        self.storage.write(variable, t, stored)
-        metrics.incr("server.sign.ok")
-        return res
+        return proof
 
     # -- write (reference: server.go:286-352) -----------------------------
 
@@ -311,6 +322,16 @@ class Server(Protocol):
             tbss, ss, self.qs.choose_quorum(qm.AUTH), self.crypt.keyring
         )
 
+        out = self._write_storage_checks(variable, val, t, sig, ss, req)
+        self.storage.write(variable, t, out)
+        metrics.incr("server.write.ok")
+        return None
+
+    def _write_storage_checks(self, variable, val, t, sig, ss, req) -> bytes:
+        """The per-variable part of ``write``: write-once, timestamp,
+        equivocation, and TOFU checks against the stored version
+        (reference: server.go:314-345).  Returns the bytes to persist
+        (the request, with inherited auth params folded in)."""
         rdata = None
         try:
             rdata = self.storage.read(variable, 0)
@@ -344,9 +365,7 @@ class Server(Protocol):
             if rp.auth is not None:  # inherit auth params
                 out = pkt.serialize(variable, val, t, sig, ss, rp.auth)
 
-        self.storage.write(variable, t, out)
-        metrics.incr("server.write.ok")
-        return None
+        return out
 
     def _revoke_signers(self, signers1: list[int], signers2: list[int]) -> bool:
         """Revoke every id present in both signer sets; broadcast the
@@ -502,6 +521,185 @@ class Server(Protocol):
     def _notify(self, req: bytes, peer, sender) -> bytes | None:
         return None  # no-op, as in the reference
 
+    # -- batch pipeline (no reference analog; see transport command doc) --
+
+    def _batch_time(self, req: bytes, peer, sender) -> bytes:
+        """B ``time`` requests in one round trip."""
+        results: list[tuple[str | None, bytes]] = []
+        for variable in pkt.parse_list(req):
+            try:
+                results.append((None, self._time(variable, peer, sender)))
+            except Exception as e:
+                results.append((_errstr(e), b""))
+        return pkt.serialize_results(results)
+
+    def _batch_sign(self, req: bytes, peer, sender) -> bytes:
+        """B ``sign`` requests in one round trip: writer-signature
+        verification and share issuance each run as ONE device batch;
+        the per-variable checks run sequentially in item order with
+        persist-as-you-go, so intra-batch conflicts hit exactly the
+        single-``sign`` equivocation path."""
+        from bftkv_tpu.ops import dispatch
+
+        reqs = pkt.parse_list(req)
+        n = len(reqs)
+        results: list[tuple[str | None, bytes] | None] = [None] * n
+        parsed: list[tuple | None] = [None] * n  # (p, issuer, tbs)
+        vitems: list = []
+        vidx: list[int] = []
+        for i, r in enumerate(reqs):
+            try:
+                p = pkt.parse(r)
+                variable, sig = p.variable or b"", p.sig
+                if sig is None:
+                    raise ERR_MALFORMED_REQUEST
+                if variable.startswith(HIDDEN_PREFIX):
+                    raise ERR_PERMISSION_DENIED
+                issuer = sigmod.issuer(sig, self.crypt.keyring)
+                sig_bytes = next(
+                    (
+                        s
+                        for sid, s in sigmod.parse_entries(sig.data)
+                        if sid == issuer.id
+                    ),
+                    None,
+                )
+                if sig_bytes is None:
+                    raise ERR_INVALID_SIGNATURE
+                tbs = pkt.tbs(r)
+                parsed[i] = (p, issuer, r)
+                vitems.append((tbs, sig_bytes, issuer.public_key))
+                vidx.append(i)
+            except Exception as e:
+                results[i] = (_errstr(e), b"")
+
+        # One device batch for every writer signature in the request.
+        if vitems:
+            d = dispatch.get()
+            ok = (
+                d.verify(vitems)
+                if d is not None
+                else self.crypt.collective.verifier.verify_batch(vitems)
+            )
+            for j, i in enumerate(vidx):
+                if not ok[j]:
+                    results[i] = (_errstr(ERR_INVALID_SIGNATURE), b"")
+                    parsed[i] = None
+
+        # Quorum certificate, cached per issuer within the batch
+        # (reference: server.go:211-214).
+        qcert_ok: dict[int, bool] = {}
+        for i in range(n):
+            if parsed[i] is None:
+                continue
+            _p, issuer, _r = parsed[i]
+            good = qcert_ok.get(issuer.id)
+            if good is None:
+                try:
+                    self._check_quorum_certificate(issuer)
+                    good = True
+                except Exception:
+                    good = False
+                qcert_ok[issuer.id] = good
+            if not good:
+                results[i] = (_errstr(ERR_INVALID_QUORUM_CERTIFICATE), b"")
+                parsed[i] = None
+
+        # Per-variable checks + persist-without-ss, sequentially: each
+        # item's check sees the previous item's persisted record.
+        tbss_list: list[bytes] = []
+        tbss_idx: list[int] = []
+        for i in range(n):
+            if parsed[i] is None:
+                continue
+            p, issuer, r = parsed[i]
+            variable, val, t, sig, ss = (
+                p.variable or b"",
+                p.value,
+                p.t,
+                p.sig,
+                p.ss,
+            )
+            try:
+                proof = self._sign_storage_checks(variable, val, t, sig, ss)
+            except Exception as e:
+                results[i] = (_errstr(e), b"")
+                continue
+            stored = pkt.serialize(variable, val, t, sig, None, proof)
+            self.storage.write(variable, t, stored)
+            tbss_list.append(pkt.tbss(r))
+            tbss_idx.append(i)
+
+        # One device batch for every collective-signature share.  No
+        # embedded cert: quorum members are in every keyring post-join,
+        # and B copies of our cert per response is pure bloat.
+        if tbss_list:
+            shares = self.crypt.signer.issue_many(tbss_list, include_cert=False)
+            for share, i in zip(shares, tbss_idx):
+                share.completed = False
+                results[i] = (None, pkt.serialize_signature(share))
+                metrics.incr("server.sign.ok")
+
+        return pkt.serialize_results(
+            [r if r is not None else (_errstr(ERR_MALFORMED_REQUEST), b"") for r in results]
+        )
+
+    def _batch_write(self, req: bytes, peer, sender) -> bytes:
+        """B ``write`` requests in one round trip; all collective
+        signatures verify in ONE device batch."""
+        reqs = pkt.parse_list(req)
+        n = len(reqs)
+        results: list[tuple[str | None, bytes] | None] = [None] * n
+        parsed: list[tuple | None] = [None] * n
+        jobs: list[tuple[bytes, object]] = []
+        jidx: list[int] = []
+        for i, r in enumerate(reqs):
+            try:
+                p = pkt.parse(r)
+                variable, sig, ss = p.variable or b"", p.sig, p.ss
+                if sig is None or ss is None:
+                    raise ERR_MALFORMED_REQUEST
+                if variable.startswith(HIDDEN_PREFIX):
+                    raise ERR_PERMISSION_DENIED
+                parsed[i] = (p, r)
+                jobs.append((pkt.tbss(r), ss))
+                jidx.append(i)
+            except Exception as e:
+                results[i] = (_errstr(e), b"")
+
+        if jobs:
+            verrs = self.crypt.collective.verify_many(
+                jobs, self.qs.choose_quorum(qm.AUTH), self.crypt.keyring
+            )
+            for j, i in enumerate(jidx):
+                if verrs[j] is not None:
+                    results[i] = (_errstr(verrs[j]), b"")
+                    parsed[i] = None
+
+        for i in range(n):
+            if parsed[i] is None:
+                continue
+            p, r = parsed[i]
+            variable, val, t, sig, ss = (
+                p.variable or b"",
+                p.value,
+                p.t,
+                p.sig,
+                p.ss,
+            )
+            try:
+                out = self._write_storage_checks(variable, val, t, sig, ss, r)
+            except Exception as e:
+                results[i] = (_errstr(e), b"")
+                continue
+            self.storage.write(variable, t, out)
+            metrics.incr("server.write.ok")
+            results[i] = (None, b"")
+
+        return pkt.serialize_results(
+            [r if r is not None else (_errstr(ERR_MALFORMED_REQUEST), b"") for r in results]
+        )
+
     _handlers = {
         tp.JOIN: "_join",
         tp.LEAVE: "_leave",
@@ -516,7 +714,17 @@ class Server(Protocol):
         tp.REGISTER: "_register",
         tp.REVOKE: "_revoke",
         tp.NOTIFY: "_notify",
+        tp.BATCH_TIME: "_batch_time",
+        tp.BATCH_SIGN: "_batch_sign",
+        tp.BATCH_WRITE: "_batch_write",
     }
+
+
+def _errstr(e) -> str:
+    """Wire form of a per-item batch error — same interned-message
+    convention as the x-error header (accepts classes and instances)."""
+    m = getattr(e, "message", None)
+    return m if isinstance(m, str) else "internal error"
 
 
 def _listen_addr(addr: str) -> str:

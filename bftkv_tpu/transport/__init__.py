@@ -44,6 +44,9 @@ __all__ = [
     "REGISTER",
     "REVOKE",
     "NOTIFY",
+    "BATCH_TIME",
+    "BATCH_SIGN",
+    "BATCH_WRITE",
     "PREFIX",
     "COMMAND_NAMES",
     "MulticastResponse",
@@ -66,6 +69,13 @@ DISTSIGN = 9
 REGISTER = 10
 REVOKE = 11
 NOTIFY = 12
+# Batch pipeline extensions (no reference analog — the reference calls
+# every phase per-variable; these carry B independent requests in one
+# round trip so server-side crypto batches into shared device launches,
+# SURVEY §7's "protocol layer accumulating work into batches").
+BATCH_TIME = 13
+BATCH_SIGN = 14
+BATCH_WRITE = 15
 
 PREFIX = "/bftkv/v1/"
 
@@ -83,6 +93,9 @@ COMMAND_NAMES = {
     REGISTER: "register",
     REVOKE: "revoke",
     NOTIFY: "notify",
+    BATCH_TIME: "batch_time",
+    BATCH_SIGN: "batch_sign",
+    BATCH_WRITE: "batch_write",
 }
 COMMANDS_BY_NAME = {v: k for k, v in COMMAND_NAMES.items()}
 

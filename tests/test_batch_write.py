@@ -1,0 +1,133 @@
+"""The batched write pipeline (``Client.write_many`` + the BATCH_*
+server handlers).
+
+The batch path must keep exact single-``write`` semantics per item —
+timestamp, quorum-certificate, equivocation, TOFU, write-once, and
+collective-signature checks all still run on every replica — while the
+three phases each cross the network once for the whole batch.  These
+tests assert equivalence with the single path, per-item error
+independence, and interop in both directions (batch-written values read
+back through the normal quorum read; singly-written variables update
+through the batch path).
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from bftkv_tpu import packet as pkt
+from bftkv_tpu.errors import ERR_INVALID_TIMESTAMP, ERR_PERMISSION_DENIED
+from bftkv_tpu.ops import dispatch
+from tests.cluster_utils import start_cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = start_cluster(4, 2, 4)
+    yield c
+    c.stop()
+
+
+def test_write_many_roundtrip(cluster):
+    c = cluster.clients[0]
+    items = [(b"batch/x%d" % i, b"value-%d" % i) for i in range(8)]
+    errs = c.write_many(items)
+    assert errs == [None] * len(items)
+    for var, val in items:
+        assert c.read(var) == val
+
+
+def test_write_many_interops_with_single_path(cluster):
+    c = cluster.clients[0]
+    # Singly-written variable updates through the batch path at t+1...
+    c.write(b"batch/mix", b"v1")
+    errs = c.write_many([(b"batch/mix", b"v2"), (b"batch/other", b"o1")])
+    assert errs == [None, None]
+    assert c.read(b"batch/mix") == b"v2"
+    # ...and a batch-written variable updates through the single path.
+    c.write(b"batch/other", b"o2")
+    assert c.read(b"batch/other") == b"o2"
+
+
+def test_write_many_per_item_errors_are_independent(cluster):
+    c = cluster.clients[0]
+    # A write-once variable rejects updates but must not sink the batch.
+    c.write_once(b"batch/frozen", b"forever")
+    errs = c.write_many(
+        [(b"batch/frozen", b"mutate?"), (b"batch/live", b"fine")]
+    )
+    # Same mapping as the single path: an immutable variable surfaces at
+    # the Time phase as maxt == 2^64-1 (client.go:90-92 analog).
+    assert errs[0] == ERR_INVALID_TIMESTAMP
+    assert errs[1] is None
+    assert c.read(b"batch/frozen") == b"forever"
+    assert c.read(b"batch/live") == b"fine"
+
+
+def test_write_many_rejects_hidden_prefix_per_item(cluster):
+    c = cluster.clients[0]
+    errs = c.write_many(
+        [(b"!!!secret!!!x", b"nope"), (b"batch/visible", b"yes")]
+    )
+    assert errs[0] == ERR_PERMISSION_DENIED
+    assert errs[1] is None
+    assert c.read(b"batch/visible") == b"yes"
+
+
+def test_write_many_rejects_duplicate_variables(cluster):
+    c = cluster.clients[0]
+    with pytest.raises(ValueError):
+        c.write_many([(b"batch/dup", b"a"), (b"batch/dup", b"b")])
+
+
+def test_write_many_empty_batch(cluster):
+    assert cluster.clients[0].write_many([]) == []
+
+
+def test_write_many_monotonic_timestamps(cluster):
+    """Repeated batches bump t exactly like repeated single writes."""
+    c = cluster.clients[0]
+    for round_no in range(3):
+        errs = c.write_many([(b"batch/t", b"round-%d" % round_no)])
+        assert errs == [None]
+    assert c.read(b"batch/t") == b"round-2"
+    srv = cluster.servers[0]
+    stored = pkt.parse(srv.storage.read(b"batch/t", 0))
+    assert stored.t == 3
+
+
+def test_write_many_two_clients_see_each_other(cluster):
+    """Client B's batch write at t, then client A single-writes at t+1
+    (same-uid TOFU applies across users of the same uid universe)."""
+    a, b = cluster.clients[0], cluster.clients[1]
+    errs = b.write_many([(b"batch/shared-%d" % i, b"from-b") for i in range(4)])
+    assert errs == [None] * 4
+    assert a.read(b"batch/shared-0") == b"from-b"
+
+
+def test_write_many_with_dispatchers_installed(cluster):
+    """The pipeline's device batches coalesce through the global
+    dispatchers exactly like the single path."""
+    dispatch.install()
+    dispatch.install_signer()
+    try:
+        c = cluster.clients[0]
+        items = [(b"batch/disp%d" % i, bytes([i]) * 64) for i in range(16)]
+        assert c.write_many(items) == [None] * 16
+        for var, val in items:
+            assert c.read(var) == val
+    finally:
+        dispatch.uninstall_all()
+
+
+def test_write_many_over_http():
+    """One batched round over real localhost HTTP sockets."""
+    c = start_cluster(4, 1, 4, transport="http")
+    try:
+        client = c.clients[0]
+        items = [(b"hb/%d" % i, b"http-%d" % i) for i in range(6)]
+        assert client.write_many(items) == [None] * 6
+        for var, val in items:
+            assert client.read(var) == val
+    finally:
+        c.stop()
