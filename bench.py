@@ -202,6 +202,42 @@ def bench_kernel_rns(batches=(4096, 16384, 65536)) -> dict:
     return out
 
 
+def bench_kernel_sign(batches=(256, 1024, 4096)) -> dict:
+    """Batched RSA-2048 CRT signs/sec through SignerDomain (the RNS
+    windowed-modexp path; reference hot loop: crypto_pgp.go:346-371)
+    vs single-core host CRT signing."""
+    from bftkv_tpu.crypto import rsa as rsamod
+
+    key = rsamod.generate(2048)
+    sd = rsamod.SignerDomain(host_threshold=0)
+    out: dict = {"batch": {}, "backend": sd.backend}
+    for b in sorted(batches):
+        items = [(b"sign-%d" % i, key) for i in range(b)]
+        t0 = time.perf_counter()
+        sigs = sd.sign_batch(items)
+        compile_s = time.perf_counter() - t0
+        assert sigs[0] == rsamod.sign(b"sign-0", key)
+        iters, elapsed = 0, 0.0
+        t0 = time.perf_counter()
+        while elapsed < (0.5 if FAST else 2.0) or iters < 2:
+            sd.sign_batch(items)
+            iters += 1
+            elapsed = time.perf_counter() - t0
+        out["batch"][str(b)] = {
+            "signs_per_sec": round(b * iters / elapsed, 1),
+            "first_call_s": round(compile_s, 2),
+        }
+    t0 = time.perf_counter()
+    for i in range(8):
+        rsamod.sign(b"host-%d" % i, key)
+    host_rate = 8 / (time.perf_counter() - t0)
+    best = max(v["signs_per_sec"] for v in out["batch"].values())
+    out["host_signs_per_sec"] = round(host_rate, 1)
+    out["best_signs_per_sec"] = best
+    out["speedup_vs_host"] = round(best / host_rate, 2)
+    return out
+
+
 def bench_kernel_ec(batches=(64, 256)) -> dict:
     """Batched P-256 scalar-mults/sec vs the host oracle (threshold-ECDSA
     hot loop, reference: crypto/threshold/ecdsa/ecdsa.go:31-59)."""
@@ -261,6 +297,28 @@ def _warm_items(count: int) -> list:
     msg = b"bench-warm"
     sig = rsa.sign(msg, key)
     return [(msg, sig, key.public)] * count
+
+
+def _warm_dispatchers(clients, bucket_max: int) -> None:
+    """Pre-compile every device bucket shape a cluster run can hit:
+    verify buckets (floor 256) and sign buckets (floor 16) up to
+    ``bucket_max``, skipping sizes below the host crossovers."""
+    from bftkv_tpu.ops import dispatch
+
+    d = dispatch.get()
+    warm_items = _warm_items(bucket_max)
+    bucket = 256
+    while bucket <= bucket_max:
+        if bucket >= d.verifier.host_threshold:
+            d.verifier.verify_batch(warm_items[:bucket])
+        bucket *= 2
+    ds = dispatch.get_signer()
+    sign_items = [(m, clients[0].crypt.signer.key) for m, _s, _k in warm_items]
+    bucket = 16
+    while bucket <= ds.max_batch:
+        if bucket >= ds.signer.host_threshold:
+            ds.signer.sign_batch(sign_items[:bucket])
+        bucket *= 2
 
 
 def _make_cluster(
@@ -333,24 +391,10 @@ def bench_cluster(
         # replicas produces ~n·suff verifies, padded to power-of-two buckets.
         clients[0].write(b"bench/warmup", value)
         clients[0].read(b"bench/warmup")
-        d = dispatch.get()
         # The dispatcher chunks flushes at max_batch, so the padded device
         # shape never exceeds the next power of two above dispatch_batch —
         # warming larger buckets would compile kernels the run cannot hit.
-        bucket_max = max(256, 1 << (dispatch_batch - 1).bit_length())
-        warm_items = _warm_items(bucket_max)
-        bucket = 256
-        while bucket <= bucket_max:
-            if bucket >= d.verifier.host_threshold:
-                d.verifier.verify_batch(warm_items[:bucket])
-            bucket *= 2
-        ds = dispatch.get_signer()
-        sign_items = [(m, clients[0].crypt.signer.key) for m, _s, _k in warm_items]
-        bucket = 16
-        while bucket <= ds.max_batch:
-            if bucket >= ds.signer.host_threshold:
-                ds.signer.sign_batch(sign_items[:bucket])
-            bucket *= 2
+        _warm_dispatchers(clients, max(256, 1 << (dispatch_batch - 1).bit_length()))
         metrics.reset()
 
         errors: list = []
@@ -432,6 +476,109 @@ def bench_cluster(
             s.tr.stop()
         if tmp is not None:
             tmp.cleanup()
+
+
+def bench_cluster_batch(
+    n_servers: int,
+    n_rw: int,
+    writers: int,
+    batch: int,
+    rounds: int,
+    *,
+    value_size: int = 1024,
+    dispatch_batch: int = 4096,
+    transport: str = "loop",
+) -> dict:
+    """Signed writes/sec through the batched pipeline (``write_many``):
+    B independent writes per protocol round, server-side crypto in
+    shared device batches.  This is the TPU-native throughput shape —
+    the per-write path (``bench_cluster``) measures latency."""
+    from bftkv_tpu.metrics import registry as metrics
+    from bftkv_tpu.ops import dispatch
+    from bftkv_tpu.storage.memkv import MemStorage
+
+    t_setup = time.perf_counter()
+    servers, clients = _make_cluster(
+        n_servers, n_rw, writers, MemStorage, transport
+    )
+    setup_s = time.perf_counter() - t_setup
+    try:
+        dispatch.install(dispatch.VerifyDispatcher(max_batch=dispatch_batch))
+        dispatch.install_signer(
+            dispatch.SignDispatcher(max_batch=dispatch_batch)
+        )
+        value = os.urandom(value_size)
+        # Warm every device bucket shape the run can hit (pays XLA
+        # compilation outside the timed region; the persistent compile
+        # cache makes repeat runs cheap).
+        _warm_dispatchers(clients, dispatch_batch)
+        clients[0].write_many(
+            [(b"bench/warm/%d" % i, value) for i in range(min(batch, 64))]
+        )
+        metrics.reset()
+
+        errors: list = []
+
+        def run(ci: int, client) -> None:
+            try:
+                for r in range(rounds):
+                    items = [
+                        (b"bench/%d/%d/%d" % (ci, r, i), value)
+                        for i in range(batch)
+                    ]
+                    errs = client.write_many(items)
+                    bad = [e for e in errs if e is not None]
+                    if bad:
+                        raise bad[0]
+            except Exception as e:
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run, args=(ci, c), daemon=True)
+            for ci, c in enumerate(clients[:writers])
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+
+        total = writers * rounds * batch
+        got = clients[0].read(b"bench/0/0/%d" % (batch - 1))
+        assert got == value, "read-back mismatch"
+
+        snap = metrics.snapshot()
+        flushes = snap.get("dispatch.flushes", 0)
+        return {
+            "replicas": n_servers,
+            "rw_nodes": n_rw,
+            "writers": writers,
+            "batch": batch,
+            "rounds": rounds,
+            "writes": total,
+            "value_bytes": value_size,
+            "transport": transport,
+            "writes_per_sec": round(total / elapsed, 2),
+            "batch_latency_p50_s": round(
+                snap.get("client.write_many.latency.p50", 0), 4
+            ),
+            "dispatch_flushes": flushes,
+            "dispatch_verifies": snap.get("dispatch.verifies", 0),
+            "dispatch_batch_p50": snap.get("dispatch.batch.p50", 0),
+            "verifies_host": snap.get("verify.host", 0),
+            "verifies_device": snap.get("verify.device", 0),
+            "signs_host": snap.get("sign.host", 0),
+            "signs_device": snap.get("sign.device", 0),
+            "sign_batch_p50": snap.get("signdispatch.batch.p50", 0),
+            "setup_s": round(setup_s, 1),
+        }
+    finally:
+        dispatch.uninstall_all()
+        for s in servers:
+            s.tr.stop()
 
 
 def bench_threshold(rounds: int = 3) -> dict:
@@ -538,9 +685,9 @@ def main() -> None:
 
     configs = _env_list(
         "BENCH_CONFIGS",
-        "kernel,rns,modexp,ec,c4,c16,tally"
+        "kernel,rns,sign,modexp,ec,c4,c16,b16,tally"
         if FAST
-        else "kernel,rns,modexp,ec,c4,c4http,c16,c64,mix64,thr,tally",
+        else "kernel,rns,sign,modexp,ec,c4,c4http,c16,c64,b16,b64,mix64,thr,tally",
     )
     batches = [int(b) for b in _env_list("BENCH_KERNEL_BATCHES", "256,1024,4096")]
     # Throughput is occupancy-driven (shared device launches amortize
@@ -568,6 +715,12 @@ def main() -> None:
             "rns_kernel",
             bench_kernel_rns,
             (1024, 4096) if FAST else (4096, 16384, 65536),
+        )
+    if "sign" in configs:
+        section(
+            "sign_kernel",
+            bench_kernel_sign,
+            (256, 1024) if FAST else (256, 1024, 4096),
         )
     if "modexp" in configs:
         section("modexp_kernel", bench_kernel_modexp, 64 if FAST else 256)
@@ -603,6 +756,18 @@ def main() -> None:
             max(2, writes // 4), storage="mem", dispatch_batch=1024,
             read_fraction=0.8,
         )
+    batch_headline = None
+    batch_size = int(os.environ.get("BENCH_BATCH", "256" if FAST else "1024"))
+    if "b16" in configs:
+        batch_headline = section(
+            "cluster_16_batched", bench_cluster_batch, 16, 4,
+            2 if FAST else 4, batch_size, 1 if FAST else 2,
+        ) or batch_headline
+    if "b64" in configs:
+        batch_headline = section(
+            "cluster_64_batched", bench_cluster_batch, 64, 8,
+            2 if FAST else 4, batch_size, 1 if FAST else 2,
+        ) or batch_headline
     if "thr" in configs:
         # BASELINE config 3/4: threshold (5,9) RSA + ECDSA signing.
         section("threshold_5_9", bench_threshold, 2 if FAST else 4)
@@ -611,7 +776,12 @@ def main() -> None:
 
     extra["total_s"] = round(time.perf_counter() - t_start, 1)
 
-    if headline is not None:
+    if batch_headline is not None:
+        value = batch_headline["writes_per_sec"]
+        metric = (
+            f"signed_writes_per_sec_{batch_headline['replicas']}replica_batched"
+        )
+    elif headline is not None:
         value = headline["writes_per_sec"]
         metric = f"signed_writes_per_sec_{headline['replicas']}replica"
     elif "rns_kernel" in extra and "best_verifies_per_sec" in extra["rns_kernel"]:
@@ -622,14 +792,15 @@ def main() -> None:
         metric = "rsa2048_verifies_per_sec"
     else:
         value, metric = 0.0, "no_configs_selected"
+    is_writes = headline is not None or batch_headline is not None
     print(
         json.dumps(
             {
                 "metric": metric,
                 "value": value,
-                "unit": "writes/s" if headline else "verifies/s",
+                "unit": "writes/s" if is_writes else "verifies/s",
                 "vs_baseline": round(value / NORTH_STAR_WRITES_PER_SEC, 5)
-                if headline
+                if is_writes
                 else None,
                 "extra": extra,
             }

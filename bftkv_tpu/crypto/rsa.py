@@ -10,6 +10,7 @@ reference gets from Go's crypto/rsa (crypto/threshold/rsa/rsa.go:345-378).
 from __future__ import annotations
 
 import hashlib
+import logging
 import threading
 from collections import OrderedDict
 from dataclasses import dataclass
@@ -19,6 +20,8 @@ import numpy as np
 from bftkv_tpu.errors import ERR_INVALID_SIGNATURE
 from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.ops import bigint, limb
+
+log = logging.getLogger("bftkv_tpu.crypto.rsa")
 
 # DigestInfo prefix for SHA-256 (RFC 8017 §9.2 note 1).
 _SHA256_PREFIX = bytes.fromhex("3031300d060960864801650304020105000420")
@@ -121,14 +124,24 @@ class SignerDomain:
 
     HOST_CROSSOVER = 16
 
-    def __init__(self, host_threshold: int | None = None):
-        if host_threshold is None:
-            import os
+    def __init__(
+        self, host_threshold: int | None = None, backend: str | None = None
+    ):
+        import os
 
+        if host_threshold is None:
             host_threshold = int(
                 os.environ.get("BFTKV_HOST_SIGN_THRESHOLD", self.HOST_CROSSOVER)
             )
         self.host_threshold = host_threshold
+        #: "rns" (default): windowed modexp in the residue number
+        #: system — MXU matmul base extensions, ~10x the limb kernel at
+        #: large batch; "limb": the XLA Montgomery limb kernel.  Keys
+        #: the RNS path cannot take fall back to the limb kernel, then
+        #: to host.
+        self.backend = backend or os.environ.get("BFTKV_SIGN_BACKEND", "rns")
+        if self.backend not in ("rns", "limb"):
+            raise ValueError(f"unknown sign backend {self.backend!r}")
         self._doms: "OrderedDict[int, bigint.MontgomeryDomain | None]" = (
             OrderedDict()
         )
@@ -172,6 +185,41 @@ class SignerDomain:
                 self._crt.popitem(last=False)
         return p
 
+    def _sign_group_rns(self, w: int, group: list, out: list) -> bool:
+        """One RNS modexp launch for a width group: both CRT halves of
+        every signature ride as rows with per-row modulus and secret
+        exponent.  Returns False (leaving ``out`` untouched) when the
+        group cannot take the RNS path — caller falls back to the limb
+        kernel."""
+        from bftkv_tpu.ops import rns as rns_ops
+
+        bases: list[int] = []
+        exps: list[int] = []
+        mods: list[int] = []
+        for _i, key, m, _domp, _domq, dp, dq, _qinv in group:
+            bases += [m, m]
+            exps += [dp, dq]
+            mods += [key.p, key.q]
+        try:
+            vals = rns_ops.power_mod_rns(bases, exps, mods, n_bits=w * 16)
+        except Exception:
+            # Unexpected kernel failure (the *expected* "can't take this
+            # key" signal is vals None): degrade to the limb path, but
+            # loudly — a silently broken RNS backend would misattribute
+            # every bench number.
+            metrics.incr("sign.rns_fallback")
+            log.exception("RNS sign path failed; falling back to limb kernel")
+            return False
+        if vals is None:
+            return False
+        metrics.incr("sign.device", len(group))
+        for j, (i, key, _m, _domp, _domq, _dp, _dq, qinv) in enumerate(group):
+            m1, m2 = vals[2 * j], vals[2 * j + 1]
+            h = (qinv * (m1 - m2)) % key.p
+            s = m2 + h * key.q
+            out[i] = s.to_bytes(key.size_bytes, "big")
+        return True
+
     def sign_batch(self, items: list[tuple[bytes, "PrivateKey"]]) -> list[bytes]:
         """[(message, key)] → [signature bytes], batched on device."""
         out: list[bytes | None] = [None] * len(items)
@@ -193,19 +241,21 @@ class SignerDomain:
                     host_idx.append(i)
                     continue
                 m = emsa_pkcs1v15_sha256(message, key.size_bytes)
-                dp, dq, _qinv = self._crt_params(key)
+                dp, dq, qinv = self._crt_params(key)
                 by_width.setdefault(w, []).append(
-                    (i, key, m, domp, domq, dp, dq)
+                    (i, key, m, domp, domq, dp, dq, qinv)
                 )
         for i in host_idx:
             out[i] = sign(items[i][0], items[i][1])
         from bftkv_tpu.ops import rsa as rsa_ops
 
         for w, group in by_width.items():
+            if self.backend == "rns" and self._sign_group_rns(w, group, out):
+                continue
             rows_base, rows_e, rows_n, rows_np, rows_r2, rows_one = (
                 [], [], [], [], [], []
             )
-            for _i, key, m, domp, domq, dp, dq in group:
+            for _i, key, m, domp, domq, dp, dq, _qinv in group:
                 for prime, dom, dexp in (
                     (key.p, domp, dp),
                     (key.q, domq, dq),
@@ -239,9 +289,8 @@ class SignerDomain:
             )[:k]
             vals = limb.limbs_to_ints(res)
             metrics.incr("sign.device", len(group))
-            for j, (i, key, m, _domp, _domq, _dp, _dq) in enumerate(group):
+            for j, (i, key, m, _domp, _domq, _dp, _dq, qinv) in enumerate(group):
                 m1, m2 = vals[2 * j], vals[2 * j + 1]
-                qinv = self._crt_params(key)[2]
                 h = (qinv * (m1 - m2)) % key.p
                 s = m2 + h * key.q
                 out[i] = s.to_bytes(key.size_bytes, "big")
@@ -452,9 +501,10 @@ class VerifierDomain:
             digit_rows.append(np.zeros(128, dtype=np.uint32))
             em_rows.append(em_rows[0])
         key_rows = rns.stack_key_rows(rows)
-        ok = np.asarray(
-            rns.verify_e65537_rns(
-                np.stack(digit_rows), np.stack(em_rows), key_rows
-            )
-        )[:k]
+        with metrics.timer("verify.launch"):
+            ok = np.asarray(
+                rns.verify_e65537_rns(
+                    np.stack(digit_rows), np.stack(em_rows), key_rows
+                )
+            )[:k]
         out[np.asarray(keep_idx)] = ok

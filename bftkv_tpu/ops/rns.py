@@ -35,6 +35,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bftkv_tpu.ops import limb
+
 __all__ = [
     "RNSContext",
     "context",
@@ -388,6 +390,159 @@ def _jitted_verify():
         return f(sig_halves, em_halves, *key)
 
     return g
+
+
+# ---------------------------------------------------------------------------
+# General modexp in RNS — the signing hot path (CRT halves of RSA keys).
+#
+# Unlike verify (fixed e = 65537), exponents here are per-row secrets up
+# to the modulus width.  Fixed 4-bit windows keep the schedule uniform
+# across the batch: every step is 4 squarings plus one multiply by a
+# table entry selected with a one-hot matvec (no data-dependent control
+# flow, no gather) — constant-time by construction, SURVEY §7 hard
+# part 3 applied to modexp.  The AMM invariant (inputs < (k+2)N keep
+# outputs < (k+2)N when M > (k+2)²N) is iteration-stable, so a
+# 256-step chain needs no extra slack over verify's 18-step chain.
+# ---------------------------------------------------------------------------
+
+
+def _pow_kernel(cn: _Consts, base_halves, exp_nibbles_t, key):
+    """acc = base^exp mod N per row; returns CRT coefficients σ over B.
+
+    ``exp_nibbles_t``: (W, T) f32 most-significant-nibble first.
+    """
+    k = cn.k
+    m2 = (key[4][:, :k], key[4][:, k:], key[5])
+
+    def one_like(x):
+        return (
+            jnp.ones_like(x[0]),
+            jnp.ones_like(x[1]),
+            jnp.ones_like(x[2]),
+        )
+
+    base = _to_residues(cn, base_halves)
+    ones = one_like(base)
+    base_m = _mont_mul(cn, base, m2, key)  # to Montgomery form
+    one_m = _mont_mul(cn, m2, ones, key)  # M mod N, the Montgomery one
+
+    # 16-entry window table in Montgomery form: t[w] = base^w.
+    tab = [one_m, base_m]
+    for _ in range(14):
+        tab.append(_mont_mul(cn, tab[-1], base_m, key))
+    tb = jnp.stack([t[0] for t in tab], axis=1)  # (T, 16, k)
+    tq = jnp.stack([t[1] for t in tab], axis=1)
+    tr = jnp.stack([t[2] for t in tab], axis=1)  # (T, 16, 1)
+
+    def body(acc, nib):
+        for _ in range(4):
+            acc = _mont_mul(cn, acc, acc, key)
+        oh = jax.nn.one_hot(nib.astype(jnp.int32), 16, dtype=jnp.float32)
+        sel = (
+            jnp.einsum("tw,twc->tc", oh, tb),
+            jnp.einsum("tw,twc->tc", oh, tq),
+            jnp.einsum("tw,twc->tc", oh, tr),
+        )
+        return _mont_mul(cn, acc, sel, key), None
+
+    acc, _ = jax.lax.scan(body, one_m, exp_nibbles_t)
+    vb, _vq, _vr = _mont_mul(cn, acc, ones, key)  # out of Montgomery form
+    # CRT coefficients: σ_i = v_i·(M_i⁻¹ mod p_i); host side rebuilds
+    # v = Σ σ_i·M_i (< M, no α ambiguity since v < (k+1)·N ≪ M).
+    return _mulmod(vb, cn.invMi_b, cn.ib, cn.pb)
+
+
+@functools.lru_cache(maxsize=4)
+def _jitted_pow(digits: int, n_bits: int):
+    cn = _Consts(context(digits, n_bits))
+
+    @jax.jit
+    def g(base_halves, exp_nibbles_t, key):
+        return _pow_kernel(cn, base_halves, exp_nibbles_t, key)
+
+    return g
+
+
+def _crt_matrix(ctx: RNSContext) -> np.ndarray:
+    """(k, D) float64 16-bit digit planes of M_i = M/p_i, cached on ctx.
+    Row sums Σ σ_i·M_i stay < k·2^12·2^16 = 2^35 < 2^53: exact."""
+    m = getattr(ctx, "_crt_digits", None)
+    if m is None:
+        width = (ctx.M.bit_length() + PR_BITS + 15) // 16 + 1
+        m = np.zeros((ctx.k, width), dtype=np.float64)
+        for i, p in enumerate(ctx.pb):
+            m[i] = limb.int_to_limbs(ctx.M // p, width)
+        ctx._crt_digits = m
+    return m
+
+
+def _sigma_to_ints(ctx: RNSContext, sigma: np.ndarray) -> list[int]:
+    """Batched RNS→integer via a float64 digit matmul + one carry pass."""
+    m = _crt_matrix(ctx)
+    acc = sigma.astype(np.float64) @ m  # (T, D) digit sums < 2^35
+    acc = acc.astype(np.int64)
+    carry = np.zeros(acc.shape[0], dtype=np.int64)
+    out = np.empty_like(acc, dtype=np.uint16)
+    for d in range(acc.shape[1]):
+        s = acc[:, d] + carry
+        out[:, d] = (s & 0xFFFF).astype(np.uint16)
+        carry = s >> 16
+    vals = [
+        int.from_bytes(row.tobytes(), "little") for row in out
+    ]
+    return [v % ctx.M for v in vals]
+
+
+def power_mod_rns(
+    bases: list[int], exps: list[int], mods: list[int], *, n_bits: int = 1024
+):
+    """Batched x^e mod m with per-row (x, e, m) — the threshold-RSA /
+    CRT-signing workhorse.  Returns a list of ints, or None when any
+    modulus cannot ride the RNS path (caller falls back).
+
+    ``n_bits`` bounds the modulus/exponent width; 1024 covers the CRT
+    halves of RSA-2048 (reference hot loop: crypto_pgp.go:346-371,
+    threshold fragments rsa.go:140-178).
+    """
+    if not mods:
+        return []
+    digits = max(32, (n_bits + 15) // 16)
+    ctx = context(digits, n_bits)
+    rows = []
+    for m in mods:
+        r = ctx.key_rows(m)
+        if r is None:
+            return None
+        rows.append(r)
+    t = len(rows)
+    # Pad to a power-of-two batch (floor 64) so only a handful of kernel
+    # shapes ever compile — same bucketing policy as the verify path.
+    padded = max(64, 1 << (t - 1).bit_length())
+    rows += [rows[0]] * (padded - t)
+    bases = list(bases) + [bases[0]] * (padded - t)
+    exps = list(exps) + [exps[0]] * (padded - t)
+    mods = list(mods) + [mods[0]] * (padded - t)
+    key = tuple(jnp.asarray(a) for a in stack_key_rows(rows))
+    base_digits = np.stack(
+        [limb.int_to_limbs(b % m, digits) for b, m in zip(bases, mods)]
+    )
+    for e in exps:
+        if e < 0 or e.bit_length() > 16 * digits:
+            return None
+    ed = np.stack([limb.int_to_limbs(e, digits) for e in exps])  # (T, digits)
+    nibbles = np.empty((len(exps), digits * 4), dtype=np.float32)
+    nibbles[:, 0::4] = ed & 0xF  # little-endian within each 16-bit digit
+    nibbles[:, 1::4] = (ed >> 4) & 0xF
+    nibbles[:, 2::4] = (ed >> 8) & 0xF
+    nibbles[:, 3::4] = (ed >> 12) & 0xF
+    nibbles = nibbles[:, ::-1]  # most-significant nibble first
+    sigma = np.asarray(
+        _jitted_pow(digits, n_bits)(
+            digits_to_halves(base_digits), np.ascontiguousarray(nibbles.T), key
+        )
+    )[:t]
+    vals = _sigma_to_ints(ctx, sigma)
+    return [v % m for v, m in zip(vals, mods[:t])]
 
 
 def digits_to_halves(digits_u32: np.ndarray) -> np.ndarray:

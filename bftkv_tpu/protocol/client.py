@@ -304,19 +304,24 @@ class Client(Protocol):
             tally = _BatchTally(n, qr.is_threshold, qr.reject)
 
             def on_time(i: int, payload: bytes):
-                if len(payload) > 8:
+                # Same strictness as the single path (`res.data and
+                # len(res.data) <= 8`): an empty or oversized timestamp
+                # is a failed response, not t=0 — a Byzantine replica
+                # must not pad the quorum with vacuous answers.
+                if not payload or len(payload) > 8:
                     return ERR_INVALID_TIMESTAMP
                 t = int.from_bytes(payload, "big")
                 if t > maxts[i]:
                     maxts[i] = t
                 return None
 
-            self.tr.multicast(
-                tp.BATCH_TIME,
-                qr.nodes(),
-                pkt.serialize_list(variables),
-                _batch_cb(tally, n, on_time),
-            )
+            with metrics.timer("client.write_many.phase_time"):
+                self.tr.multicast(
+                    tp.BATCH_TIME,
+                    qr.nodes(),
+                    pkt.serialize_list(variables),
+                    _batch_cb(tally, n, on_time),
+                )
             for i in range(n):
                 err = tally.item_error(i, ERR_INSUFFICIENT_NUMBER_OF_QUORUM)
                 if err is not None:
@@ -333,7 +338,10 @@ class Client(Protocol):
                 pkt.serialize(items[i][0], items[i][1], ts[i], nfields=3)
                 for i in pending
             ]
-            sigs = dict(zip(pending, self.crypt.signer.issue_many(tbs_list)))
+            with metrics.timer("client.write_many.phase_self_sign"):
+                sigs = dict(
+                    zip(pending, self.crypt.signer.issue_many(tbs_list))
+                )
             reqs = [
                 pkt.serialize(items[i][0], items[i][1], ts[i], sigs[i], proof)
                 for i in pending
@@ -369,12 +377,13 @@ class Client(Protocol):
                 except Exception as e:
                     return e
 
-            self.tr.multicast(
-                tp.BATCH_SIGN,
-                qa.nodes(),
-                pkt.serialize_list(reqs),
-                _batch_cb(stally, len(pending), on_share),
-            )
+            with metrics.timer("client.write_many.phase_sign"):
+                self.tr.multicast(
+                    tp.BATCH_SIGN,
+                    qa.nodes(),
+                    pkt.serialize_list(reqs),
+                    _batch_cb(stally, len(pending), on_share),
+                )
             jobs: list[tuple[bytes, pkt.SignaturePacket]] = []
             jidx: list[int] = []
             sss: dict[int, pkt.SignaturePacket] = {}
@@ -404,9 +413,10 @@ class Client(Protocol):
                 jobs.append((tbss, ss))
                 jidx.append(i)
             if jobs:
-                verrs = self.crypt.collective.verify_many(
-                    jobs, qa, self.crypt.keyring
-                )
+                with metrics.timer("client.write_many.phase_verify"):
+                    verrs = self.crypt.collective.verify_many(
+                        jobs, qa, self.crypt.keyring
+                    )
                 for j, i in enumerate(jidx):
                     if verrs[j] is not None:
                         results[i] = verrs[j]
@@ -423,12 +433,13 @@ class Client(Protocol):
             ]
             qw = self.qs.choose_quorum(qm.WRITE)
             wtally = _BatchTally(len(pending), qw.is_threshold, qw.reject)
-            self.tr.multicast(
-                tp.BATCH_WRITE,
-                qw.nodes(),
-                pkt.serialize_list(data),
-                _batch_cb(wtally, len(pending), lambda k, payload: None),
-            )
+            with metrics.timer("client.write_many.phase_write"):
+                self.tr.multicast(
+                    tp.BATCH_WRITE,
+                    qw.nodes(),
+                    pkt.serialize_list(data),
+                    _batch_cb(wtally, len(pending), lambda k, payload: None),
+                )
             nok = 0
             for k, i in enumerate(pending):
                 err = wtally.item_error(

@@ -668,9 +668,10 @@ class Server(Protocol):
                 results[i] = (_errstr(e), b"")
 
         if jobs:
-            verrs = self.crypt.collective.verify_many(
-                jobs, self.qs.choose_quorum(qm.AUTH), self.crypt.keyring
-            )
+            with metrics.timer("server.batch_write.verify"):
+                verrs = self.crypt.collective.verify_many(
+                    jobs, self.qs.choose_quorum(qm.AUTH), self.crypt.keyring
+                )
             for j, i in enumerate(jidx):
                 if verrs[j] is not None:
                     results[i] = (_errstr(verrs[j]), b"")
