@@ -301,11 +301,13 @@ def _warm_items(count: int) -> list:
 
 def _warm_dispatchers(clients, bucket_max: int) -> None:
     """Pre-compile every device bucket shape a cluster run can hit:
-    verify buckets (floor 256) and sign buckets (floor 16) up to
-    ``bucket_max``, skipping sizes below the host crossovers."""
+    verify buckets (floor 256) up to the power-of-two ceiling of
+    ``bucket_max`` and sign buckets up to the sign dispatcher's
+    ``max_batch``, skipping sizes below the host crossovers."""
     from bftkv_tpu.ops import dispatch
 
     d = dispatch.get()
+    bucket_max = max(256, 1 << (bucket_max - 1).bit_length())
     warm_items = _warm_items(bucket_max)
     bucket = 256
     while bucket <= bucket_max:
@@ -394,7 +396,7 @@ def bench_cluster(
         # The dispatcher chunks flushes at max_batch, so the padded device
         # shape never exceeds the next power of two above dispatch_batch —
         # warming larger buckets would compile kernels the run cannot hit.
-        _warm_dispatchers(clients, max(256, 1 << (dispatch_batch - 1).bit_length()))
+        _warm_dispatchers(clients, dispatch_batch)
         metrics.reset()
 
         errors: list = []
@@ -565,6 +567,19 @@ def bench_cluster_batch(
             "batch_latency_p50_s": round(
                 snap.get("client.write_many.latency.p50", 0), 4
             ),
+            # A production replica has its own TPU; the in-process bench
+            # time-slices one chip across all n. Per-replica handler
+            # capacity is the deployment-shaped number.
+            "replica_sign_handler_items_per_sec": round(
+                batch / h, 1
+            )
+            if (h := snap.get("server.batch_sign.handler.p50", 0))
+            else 0,
+            "replica_write_handler_items_per_sec": round(
+                batch / h, 1
+            )
+            if (h := snap.get("server.batch_write.handler.p50", 0))
+            else 0,
             "dispatch_flushes": flushes,
             "dispatch_verifies": snap.get("dispatch.verifies", 0),
             "dispatch_batch_p50": snap.get("dispatch.batch.p50", 0),

@@ -268,6 +268,16 @@ def main(argv: list[str] | None = None) -> int:
                     help="install the TPU verify/sign dispatchers "
                          "(one replica process per accelerator)")
     args = ap.parse_args(argv)
+    # Honor JAX_PLATFORMS=cpu *robustly*: ambient sitecustomize may
+    # register an accelerator PJRT plugin at interpreter start, and the
+    # profiler/trace endpoint initializes every registered backend — a
+    # dead accelerator tunnel would hang the API thread.  force_cpu
+    # repairs the already-imported jax in-process (same mechanism as
+    # the test suite's conftest).
+    if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
+        from bftkv_tpu.hostcpu import force_cpu
+
+        force_cpu(1)
     if not args.db and args.storage != "mem":
         args.db = args.home.rstrip("/") + ".db"
     if not args.revlist:
@@ -328,8 +338,6 @@ def main(argv: list[str] | None = None) -> int:
         tmp = args.revlist + "~"
         with open(tmp, "wb") as f:
             f.write(rl)
-        import os
-
         os.replace(tmp, args.revlist)
     if api_httpd is not None:
         api_httpd.shutdown()

@@ -129,6 +129,9 @@ class SignerDomain:
     ):
         import os
 
+        from bftkv_tpu import ops
+
+        ops.enable_compile_cache()
         if host_threshold is None:
             host_threshold = int(
                 os.environ.get("BFTKV_HOST_SIGN_THRESHOLD", self.HOST_CROSSOVER)
@@ -213,12 +216,81 @@ class SignerDomain:
         if vals is None:
             return False
         metrics.incr("sign.device", len(group))
-        for j, (i, key, _m, _domp, _domq, _dp, _dq, qinv) in enumerate(group):
+        sigs: list[tuple[int, object, int]] = []  # (item idx, key, s)
+        for j, (i, key, m, _domp, _domq, _dp, _dq, qinv) in enumerate(group):
             m1, m2 = vals[2 * j], vals[2 * j + 1]
             h = (qinv * (m1 - m2)) % key.p
             s = m2 + h * key.q
-            out[i] = s.to_bytes(key.size_bytes, "big")
+            sigs.append((i, key, s))
+        # Fault check (Boneh–DeMillo–Lipton): one silently wrong CRT
+        # half would let any observer factor the modulus via
+        # gcd(s^e − em, n).  Verify every output before release — one
+        # cheap e=65537 batch (17 modmuls) against the 1280-modmul
+        # sign — and re-sign faulted items on the host.
+        ok = self._fault_check(sigs, group)
+        for (i, key, s), good, g in zip(sigs, ok, group):
+            if good:
+                out[i] = s.to_bytes(key.size_bytes, "big")
+            else:
+                metrics.incr("sign.fault")
+                log.error(
+                    "RNS sign fault check failed for one signature; "
+                    "re-signing on host"
+                )
+                # Straight pow, no CRT: after a fault, produce the
+                # signature by the most fault-immune route available.
+                out[i] = pow(g[2], key.d, key.n).to_bytes(
+                    key.size_bytes, "big"
+                )
         return True
+
+    @staticmethod
+    def _fault_check(sigs: list, group: list) -> list[bool]:
+        """s^65537 ≡ em (mod n) for every produced signature, as one
+        RNS verify batch when the moduli allow, host ``pow`` otherwise."""
+        from bftkv_tpu.ops import rns as rns_ops
+
+        ems = [g[2] for g in group]
+        ctx = rns_ops.context()
+        unique: dict[int, int] = {}
+        urows: list = []
+        idxs: list[int] = []
+        dig_s: list[np.ndarray] = []
+        dig_em: list[np.ndarray] = []
+        device_pos: list[int] = []
+        ok = [False] * len(sigs)
+        for pos, ((_i, key, s), em) in enumerate(zip(sigs, ems)):
+            kr = ctx.key_rows(key.n) if key.e == F4 else None
+            if kr is None:
+                ok[pos] = pow(s, key.e, key.n) == em
+                continue
+            u = unique.get(key.n)
+            if u is None:
+                u = unique[key.n] = len(urows)
+                urows.append(kr)
+            idxs.append(u)
+            dig_s.append(limb.int_to_limbs(s, 128))
+            dig_em.append(limb.int_to_limbs(em, 128))
+            device_pos.append(pos)
+        if device_pos:
+            k = len(device_pos)
+            padded = max(256, 1 << (k - 1).bit_length())
+            idxs += [0] * (padded - k)
+            dig_s += [np.zeros(128, dtype=np.uint32)] * (padded - k)
+            dig_em += [dig_em[0]] * (padded - k)
+            kpad = max(64, 1 << (len(urows) - 1).bit_length())
+            urows += [urows[0]] * (kpad - len(urows))
+            good = np.asarray(
+                rns_ops.verify_e65537_rns_indexed(
+                    np.stack(dig_s),
+                    np.stack(dig_em),
+                    idxs,
+                    rns_ops.stack_key_rows(urows),
+                )
+            )[:k]
+            for pos, g in zip(device_pos, good):
+                ok[pos] = bool(g)
+        return ok
 
     def sign_batch(self, items: list[tuple[bytes, "PrivateKey"]]) -> list[bytes]:
         """[(message, key)] → [signature bytes], batched on device."""
@@ -328,6 +400,9 @@ class VerifierDomain:
     ):
         import os
 
+        from bftkv_tpu import ops
+
+        ops.enable_compile_cache()
         self.nlimbs = nlimbs
         if host_threshold is None:
             host_threshold = int(
@@ -462,11 +537,19 @@ class VerifierDomain:
         return out
 
     def _verify_rns(self, device_idx, device_items, out) -> None:
-        """RNS device path with per-item fallback for incapable keys."""
+        """RNS device path with per-item fallback for incapable keys.
+
+        Key rows are deduplicated host-side and gathered on device: a
+        protocol flush repeats a handful of cluster keys thousands of
+        times, and on a tunneled TPU the per-row key transfer would
+        cost ~7x the kernel itself.
+        """
         from bftkv_tpu.ops import rns
 
         ctx = rns.context()
-        rows, digit_rows, em_rows, keep_idx = [], [], [], []
+        unique: dict[int, int] = {}
+        urows: list = []
+        idxs, digit_rows, em_rows, keep_idx = [], [], [], []
         for j, (message, sig_bytes, key) in zip(device_idx, device_items):
             kr = ctx.key_rows(key.n)
             s = int.from_bytes(sig_bytes, "big")
@@ -481,7 +564,11 @@ class VerifierDomain:
                 except Exception:
                     out[j] = False
                 continue
-            rows.append(kr)
+            u = unique.get(key.n)
+            if u is None:
+                u = unique[key.n] = len(urows)
+                urows.append(kr)
+            idxs.append(u)
             digit_rows.append(limb.int_to_limbs(s, 128))
             em_rows.append(
                 limb.int_to_limbs(
@@ -489,22 +576,29 @@ class VerifierDomain:
                 )
             )
             keep_idx.append(j)
-        if not rows:
+        if not idxs:
             return
-        k = len(rows)
+        k = len(idxs)
         metrics.incr("verify.device", k)
         # Power-of-two buckets (floor 256), padding with row 0's key and
         # sig digits of 0 — 0^e never equals a PKCS#1 encoding.
         padded = max(256, 1 << (k - 1).bit_length())
         for _ in range(padded - k):
-            rows.append(rows[0])
+            idxs.append(0)
             digit_rows.append(np.zeros(128, dtype=np.uint32))
             em_rows.append(em_rows[0])
-        key_rows = rns.stack_key_rows(rows)
+        # The unique-key axis is padded to a fixed floor of 64 (64 rows
+        # ≈ 800 KB of transfer — noise) so the (T, K) shape pair is a
+        # function of T alone in any realistic cluster; a flush with
+        # more distinct keys escalates to the next power of two and
+        # pays one recompile.
+        kpad = max(64, 1 << (len(urows) - 1).bit_length())
+        urows += [urows[0]] * (kpad - len(urows))
+        unique_rows = rns.stack_key_rows(urows)
         with metrics.timer("verify.launch"):
             ok = np.asarray(
-                rns.verify_e65537_rns(
-                    np.stack(digit_rows), np.stack(em_rows), key_rows
+                rns.verify_e65537_rns_indexed(
+                    np.stack(digit_rows), np.stack(em_rows), idxs, unique_rows
                 )
             )[:k]
         out[np.asarray(keep_idx)] = ok

@@ -12,4 +12,29 @@ Modules:
 - ``tally``  — vmapped quorum/graph boolean reductions
 """
 
+import os as _os
+
 from bftkv_tpu.ops import bigint, limb  # noqa: F401
+
+
+def enable_compile_cache() -> None:
+    """Point jax at a persistent compilation cache (idempotent).
+
+    The RNS kernels compile in tens of seconds per bucket shape on TPU;
+    with the cache, daemon restarts and repeat bench runs skip XLA
+    entirely.  ``BFTKV_COMPILE_CACHE`` overrides the location; an empty
+    value disables.  Called lazily by every device entry point.
+    """
+    path = _os.environ.get(
+        "BFTKV_COMPILE_CACHE",
+        _os.path.expanduser("~/.cache/jax_bftkv"),
+    )
+    if not path:
+        return
+    try:
+        import jax
+
+        if jax.config.jax_compilation_cache_dir != path:
+            jax.config.update("jax_compilation_cache_dir", path)
+    except Exception:
+        pass  # cache is an optimization, never a failure

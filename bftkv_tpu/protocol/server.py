@@ -539,6 +539,10 @@ class Server(Protocol):
         the per-variable checks run sequentially in item order with
         persist-as-you-go, so intra-batch conflicts hit exactly the
         single-``sign`` equivocation path."""
+        with metrics.timer("server.batch_sign.handler"):
+            return self._batch_sign_inner(req, peer, sender)
+
+    def _batch_sign_inner(self, req: bytes, peer, sender) -> bytes:
         from bftkv_tpu.ops import dispatch
 
         reqs = pkt.parse_list(req)
@@ -647,6 +651,10 @@ class Server(Protocol):
     def _batch_write(self, req: bytes, peer, sender) -> bytes:
         """B ``write`` requests in one round trip; all collective
         signatures verify in ONE device batch."""
+        with metrics.timer("server.batch_write.handler"):
+            return self._batch_write_inner(req, peer, sender)
+
+    def _batch_write_inner(self, req: bytes, peer, sender) -> bytes:
         reqs = pkt.parse_list(req)
         n = len(reqs)
         results: list[tuple[str | None, bytes] | None] = [None] * n

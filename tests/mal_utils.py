@@ -61,6 +61,32 @@ class MalServer(Server):
             self.storage.write(p.variable or b"", p.t, req)
         return None
 
+    # The batch pipeline must face the same adversary: a colluder signs
+    # and stores every item of a batch without any verification.
+
+    def _batch_sign(self, req: bytes, peer, sender):
+        if not self._is_mal:
+            return super()._batch_sign(req, peer, sender)
+        results = []
+        for r in pkt.parse_list(req):
+            pkt.parse(r)
+            share = self.crypt.collective.sign(self.crypt.signer, pkt.tbss(r))
+            results.append((None, pkt.serialize_signature(share)))
+        return pkt.serialize_results(results)
+
+    def _batch_write(self, req: bytes, peer, sender):
+        if not self._is_mal:
+            return super()._batch_write(req, peer, sender)
+        results = []
+        for r in pkt.parse_list(req):
+            p = pkt.parse(r)
+            if isinstance(self.storage, MalStorage):
+                self.storage.mal_write(p.variable or b"", p.t, r)
+            else:
+                self.storage.write(p.variable or b"", p.t, r)
+            results.append((None, b""))
+        return pkt.serialize_results(results)
+
 
 class MalClient(Client):
     """The textbook equivocator: writes <x,t,v> to one half of each

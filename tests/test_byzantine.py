@@ -87,6 +87,23 @@ def test_honest_write_survives_colluders(mal_cluster):
     assert honest.read(b"sane_var") == b"sane value"
 
 
+def test_honest_batch_write_survives_colluders(mal_cluster):
+    """The batched pipeline under the same adversary: colluders sign and
+    store every item unverified, honest replicas still enforce the full
+    checks, and the b-masking quorum carries the batch through."""
+    c, mal = mal_cluster
+    honest = c.clients[1]
+    items = [(b"sane_batch/%d" % i, b"batch value %d" % i) for i in range(12)]
+    assert honest.write_many(items) == [None] * 12
+    for var, val in items:
+        assert honest.read(var) == val
+    # A second batch updates the same variables at t+1 — the colluders'
+    # stored garbage must not poison the timestamp phase.
+    items2 = [(v, b"updated " + val) for v, val in items]
+    assert honest.write_many(items2) == [None] * 12
+    assert honest.read(b"sane_batch/0") == b"updated batch value 0"
+
+
 def test_same_uid_may_overwrite(mal_cluster):
     """TOFU allows a different key with the SAME uid to overwrite
     (reference: server.go:329-337 — id *or* uid match; mal_test.go
