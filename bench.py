@@ -490,11 +490,13 @@ def bench_cluster_batch(
     value_size: int = 1024,
     dispatch_batch: int = 4096,
     transport: str = "loop",
+    read_fraction: float = 0.0,
 ) -> dict:
     """Signed writes/sec through the batched pipeline (``write_many``):
     B independent writes per protocol round, server-side crypto in
-    shared device batches.  This is the TPU-native throughput shape —
-    the per-write path (``bench_cluster``) measures latency."""
+    shared device batches.  ``read_fraction`` adds ``read_many`` rounds
+    for the BASELINE config-4 mix.  This is the TPU-native throughput
+    shape — the per-write path (``bench_cluster``) measures latency."""
     from bftkv_tpu.metrics import registry as metrics
     from bftkv_tpu.ops import dispatch
     from bftkv_tpu.storage.memkv import MemStorage
@@ -520,8 +522,15 @@ def bench_cluster_batch(
         metrics.reset()
 
         errors: list = []
+        reads_done = [0] * writers
+        reads_per_round = (
+            int(batch * read_fraction / (1 - read_fraction))
+            if read_fraction
+            else 0
+        )
 
         def run(ci: int, client) -> None:
+            rng = np.random.default_rng(ci)
             try:
                 for r in range(rounds):
                     items = [
@@ -532,6 +541,25 @@ def bench_cluster_batch(
                     bad = [e for e in errs if e is not None]
                     if bad:
                         raise bad[0]
+                    for off in range(0, reads_per_round, batch):
+                        nread = min(batch, reads_per_round - off)
+                        got = client.read_many(
+                            [
+                                b"bench/%d/%d/%d"
+                                % (ci, r, rng.integers(0, batch))
+                                for _ in range(nread)
+                            ]
+                        )
+                        # Per-item errors are interned Error *classes*
+                        # or instances; values are bytes/None.
+                        bad = [
+                            g
+                            for g in got
+                            if g is not None and not isinstance(g, bytes)
+                        ]
+                        if bad:
+                            raise bad[0]
+                        reads_done[ci] += nread
             except Exception as e:
                 errors.append(e)
 
@@ -549,6 +577,7 @@ def bench_cluster_batch(
             raise errors[0]
 
         total = writers * rounds * batch
+        total_reads = sum(reads_done)
         got = clients[0].read(b"bench/0/0/%d" % (batch - 1))
         assert got == value, "read-back mismatch"
 
@@ -561,6 +590,8 @@ def bench_cluster_batch(
             "batch": batch,
             "rounds": rounds,
             "writes": total,
+            "reads": total_reads,
+            "ops_per_sec": round((total + total_reads) / elapsed, 2),
             "value_bytes": value_size,
             "transport": transport,
             "writes_per_sec": round(total / elapsed, 2),
@@ -702,7 +733,7 @@ def main() -> None:
         "BENCH_CONFIGS",
         "kernel,rns,sign,modexp,ec,c4,c16,b16,tally"
         if FAST
-        else "kernel,rns,sign,modexp,ec,c4,c4http,c16,c64,b16,b64,mix64,thr,tally",
+        else "kernel,rns,sign,modexp,ec,c4,c4http,c16,c64,b16,b64,bmix64,thr,tally",
     )
     batches = [int(b) for b in _env_list("BENCH_KERNEL_BATCHES", "256,1024,4096")]
     # Throughput is occupancy-driven (shared device launches amortize
@@ -783,6 +814,12 @@ def main() -> None:
             "cluster_64_batched", bench_cluster_batch, 64, 8,
             2 if FAST else 4, batch_size, 1 if FAST else 2,
         ) or batch_headline
+    if "bmix64" in configs:
+        # BASELINE config 4, batched: 64 replicas, 80/20 read/write.
+        section(
+            "cluster_64_batched_mix", bench_cluster_batch, 64, 8,
+            2 if FAST else 4, batch_size, 1, read_fraction=0.8,
+        )
     if "thr" in configs:
         # BASELINE config 3/4: threshold (5,9) RSA + ECDSA signing.
         section("threshold_5_9", bench_threshold, 2 if FAST else 4)

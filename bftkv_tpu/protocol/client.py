@@ -452,6 +452,126 @@ class Client(Protocol):
             metrics.incr("client.write.ok", nok)
             return results
 
+    def read_many(
+        self, variables: list[bytes], proof=None
+    ) -> list[bytes | None | Exception | type[Exception]]:
+        """Batched quorum read: one round trip carries B variables.
+
+        Same per-item semantics as ``read`` — responses bucket by
+        ``(t, value)`` per variable, a value wins once its responder
+        set reaches threshold at the max timestamp, equivocating
+        signers are revoked (one NOTIFY broadcast for the whole
+        batch), and stale replicas get read-repaired (per-node batches
+        of exactly the packets each node is missing).  Like the single
+        path, the fan-out consumes every response and revocation +
+        repair run on a background worker after the values return.
+
+        Returns one entry per variable: the value bytes, ``None`` for
+        an empty value, or the per-item error (an interned ``Error``
+        class or instance — compare with ``==`` as usual).
+        """
+        if not variables:
+            return []
+        n = len(variables)
+        q = self.qs.choose_quorum(qm.READ)
+        reqs = [pkt.serialize(v, None, 0, None, proof) for v in variables]
+        ms: list[dict] = [{} for _ in range(n)]
+        fails: list[list] = [[] for _ in range(n)]
+
+        with metrics.timer("client.read_many.latency"):
+
+            def cb(res: tp.MulticastResponse) -> bool:
+                if res.err is not None or res.data is None:
+                    for f in fails:
+                        f.append(res.err)
+                    return False
+                try:
+                    out = pkt.parse_results(res.data)
+                    if len(out) != n:
+                        raise ERR_MALFORMED_REQUEST
+                except Exception as e:
+                    for f in fails:
+                        f.append(e)
+                    return False
+                for k, (errstr, payload) in enumerate(out):
+                    if errstr is not None:
+                        fails[k].append(error_from_string(errstr))
+                        continue
+                    err = self._process_response(
+                        tp.MulticastResponse(res.peer, payload or None, None),
+                        ms[k],
+                    )
+                    if err is not None:
+                        fails[k].append(err)
+                return False  # consume the full quorum, as _read_worker does
+
+            self.tr.multicast(
+                tp.BATCH_READ, q.nodes(), pkt.serialize_list(reqs), cb
+            )
+
+            results: list = []
+            winners: list[tuple[int, bytes | None, int]] = []
+            for k in range(n):
+                try:
+                    value, maxt = self._max_timestamped_value(ms[k], q)
+                    results.append(value)
+                    winners.append((k, value, maxt))
+                except _InProgress:
+                    results.append(
+                        majority_error(
+                            [e for e in fails[k] if e is not None],
+                            ERR_INSUFFICIENT_NUMBER_OF_RESPONSES,
+                        )
+                    )
+            metrics.incr("client.read.ok", len(winners))
+
+        # Revocation + repair happen after the caller has its values,
+        # mirroring _read_worker's early delivery: one lagging stale
+        # replica must not inflate every batched read.
+        worker = threading.Thread(
+            target=self._read_many_post,
+            args=(q, ms, winners),
+            daemon=True,
+        )
+        worker.start()
+        return results
+
+    def _read_many_post(self, q, ms: list[dict], winners: list) -> None:
+        # Revoke equivocators across the whole batch; one NOTIFY.
+        revoked: set[int] = set()
+        for m in ms:
+            revoked |= self._revoke_equivocators(m, revoked)
+        if revoked:
+            self._broadcast_revocations()
+
+        # Read-repair, grouped per stale node so each replica receives
+        # exactly the packets it is missing (a union batch would make
+        # every stale node re-verify the whole batch: O(B²) work).
+        per_node: dict[int, tuple[object, list[bytes]]] = {}
+        repaired = 0
+        for _k, value, maxt in winners:
+            if not value:
+                continue
+            m = ms[_k]
+            bucket = m.get(maxt, {}).get(value)
+            if not bucket or bucket[0].packet is None:
+                continue
+            have = {sv.node.id for sv in bucket}
+            stale = [nd for nd in q.nodes() if nd.id not in have]
+            if stale:
+                repaired += 1
+                for nd in stale:
+                    per_node.setdefault(nd.id, (nd, []))[1].append(
+                        bucket[0].packet
+                    )
+        if per_node:
+            metrics.incr("client.read.repair", repaired)
+            peers = [nd for nd, _pkts in per_node.values()]
+            payloads = [
+                pkt.serialize_list(pkts) for _nd, pkts in per_node.values()
+            ]
+            self.tr.multicast_m(tp.BATCH_WRITE, peers, payloads, None)
+
     # -- read path (reference: client.go:189-353) -------------------------
 
     def read(self, variable: bytes, proof=None) -> bytes | None:
@@ -576,6 +696,13 @@ class Client(Protocol):
         """Signers that signed two different values at the same
         timestamp get revoked; the revocation list is broadcast
         (reference: client.go:304-353)."""
+        if self._revoke_equivocators(m, set()):
+            self._broadcast_revocations()
+
+    def _revoke_equivocators(self, m, already: set[int]) -> set[int]:
+        """Scan one response map and revoke double-signers not in
+        ``already``; returns the newly revoked ids (the caller owns the
+        NOTIFY broadcast so batched reads send it once)."""
         revoked: set[int] = set()
         for t, vl in m.items():
             if t == 0:
@@ -601,15 +728,15 @@ class Client(Protocol):
                         elif prev != round_no:
                             bad.add(sid)
             for sid in bad:
-                if sid not in revoked:
+                if sid not in revoked and sid not in already:
                     self._do_revoke(sid)
                     revoked.add(sid)
-        if revoked:
-            rl = self.self_node.serialize_revoked()
-            if rl:
-                self.tr.multicast(
-                    tp.NOTIFY, self.self_node.get_peers(), rl, None
-                )
+        return revoked
+
+    def _broadcast_revocations(self) -> None:
+        rl = self.self_node.serialize_revoked()
+        if rl:
+            self.tr.multicast(tp.NOTIFY, self.self_node.get_peers(), rl, None)
 
     @staticmethod
     def _equivocators_batched(rows: list[set[int]]) -> set[int]:

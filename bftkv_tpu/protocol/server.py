@@ -163,8 +163,9 @@ class Server(Protocol):
 
     def _read(self, req: bytes, peer, sender) -> bytes | None:
         p = pkt.parse(req)
-        variable = p.variable or b""
-        proof = p.ss  # the client's TPA proof rides in the ss slot
+        return self._read_item(p.variable or b"", p.ss)
+
+    def _read_item(self, variable: bytes, proof) -> bytes | None:
         if variable.startswith(HIDDEN_PREFIX):
             raise ERR_PERMISSION_DENIED
         raw = None
@@ -533,6 +534,20 @@ class Server(Protocol):
                 results.append((_errstr(e), b""))
         return pkt.serialize_results(results)
 
+    def _batch_read(self, req: bytes, peer, sender) -> bytes:
+        """B ``read`` requests in one round trip.  An ok item with an
+        empty payload means "no data" — the client buckets it at t=0
+        exactly like an empty single-read response."""
+        results: list[tuple[str | None, bytes]] = []
+        for r in pkt.parse_list(req):
+            try:
+                p = pkt.parse(r)
+                raw = self._read_item(p.variable or b"", p.ss)
+                results.append((None, raw or b""))
+            except Exception as e:
+                results.append((_errstr(e), b""))
+        return pkt.serialize_results(results)
+
     def _batch_sign(self, req: bytes, peer, sender) -> bytes:
         """B ``sign`` requests in one round trip: writer-signature
         verification and share issuance each run as ONE device batch;
@@ -726,6 +741,7 @@ class Server(Protocol):
         tp.BATCH_TIME: "_batch_time",
         tp.BATCH_SIGN: "_batch_sign",
         tp.BATCH_WRITE: "_batch_write",
+        tp.BATCH_READ: "_batch_read",
     }
 
 

@@ -47,6 +47,7 @@ __all__ = [
     "BATCH_TIME",
     "BATCH_SIGN",
     "BATCH_WRITE",
+    "BATCH_READ",
     "PREFIX",
     "COMMAND_NAMES",
     "MulticastResponse",
@@ -76,6 +77,7 @@ NOTIFY = 12
 BATCH_TIME = 13
 BATCH_SIGN = 14
 BATCH_WRITE = 15
+BATCH_READ = 16
 
 PREFIX = "/bftkv/v1/"
 
@@ -96,6 +98,7 @@ COMMAND_NAMES = {
     BATCH_TIME: "batch_time",
     BATCH_SIGN: "batch_sign",
     BATCH_WRITE: "batch_write",
+    BATCH_READ: "batch_read",
 }
 COMMANDS_BY_NAME = {v: k for k, v in COMMAND_NAMES.items()}
 

@@ -120,6 +120,55 @@ def test_write_many_with_dispatchers_installed(cluster):
         dispatch.uninstall_all()
 
 
+def test_read_many_roundtrip(cluster):
+    c = cluster.clients[0]
+    items = [(b"rm/%d" % i, b"rv-%d" % i) for i in range(6)]
+    assert c.write_many(items) == [None] * 6
+    got = c.read_many([v for v, _ in items])
+    assert got == [val for _, val in items]
+
+
+def test_read_many_mixed_missing_and_errors(cluster):
+    c = cluster.clients[0]
+    c.write(b"rm/present", b"here")
+    got = c.read_many([b"rm/present", b"rm/never-written", b"!!!secret!!!x"])
+    assert got[0] == b"here"
+    assert got[1] is None  # no data: every replica answers "empty"
+    assert got[2] == ERR_PERMISSION_DENIED  # hidden prefix, per item
+
+
+def test_read_many_repairs_stale_replica(cluster):
+    """A replica that missed the write phase gets read-repaired by the
+    batch.  The victim must be a node the READ quorum actually consults
+    (a storage node: W = U − {Ci} + R lands writes there), and healing
+    means the *collective signature* is back, not just the value."""
+    import time
+
+    c = cluster.clients[0]
+    c.write(b"rm/heal", b"healthy")
+    victim = cluster.storage_servers[0]
+    stored = victim.storage.read(b"rm/heal", 0)
+    p = pkt.parse(stored)
+    assert p.ss is not None and p.ss.completed  # precondition: healthy
+    # Realistic staleness: the replica saw the sign request (persisted
+    # without ss — the in-progress marker) but missed the write phase.
+    victim.storage.write(
+        b"rm/heal",
+        p.t,
+        pkt.serialize(b"rm/heal", p.value, p.t, p.sig, None),
+    )
+    got = c.read_many([b"rm/heal"])
+    assert got == [b"healthy"]
+    deadline = time.time() + 5
+    healed = False
+    while time.time() < deadline and not healed:
+        rp = pkt.parse(victim.storage.read(b"rm/heal", 0))
+        healed = rp.ss is not None and rp.ss.completed
+        if not healed:
+            time.sleep(0.05)
+    assert healed, "stale replica was not repaired by read_many"
+
+
 def test_write_many_over_http():
     """One batched round over real localhost HTTP sockets."""
     c = start_cluster(4, 1, 4, transport="http")
@@ -129,5 +178,8 @@ def test_write_many_over_http():
         assert client.write_many(items) == [None] * 6
         for var, val in items:
             assert client.read(var) == val
+        assert client.read_many([v for v, _ in items]) == [
+            val for _, val in items
+        ]
     finally:
         c.stop()
