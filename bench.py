@@ -550,15 +550,12 @@ def bench_cluster_batch(
                                 for _ in range(nread)
                             ]
                         )
-                        # Per-item errors are interned Error *classes*
-                        # or instances; values are bytes/None.
-                        bad = [
-                            g
-                            for g in got
-                            if g is not None and not isinstance(g, bytes)
-                        ]
+                        # Every bench key was just written, so anything
+                        # but value bytes (None included) is a failure;
+                        # errors are interned Error classes/instances.
+                        bad = [g for g in got if not isinstance(g, bytes)]
                         if bad:
-                            raise bad[0]
+                            raise AssertionError(f"bench read failed: {bad[0]!r}")
                         reads_done[ci] += nread
             except Exception as e:
                 errors.append(e)

@@ -127,6 +127,21 @@ class API:
             value = dataenc.encrypt(value, key)
         self.client.write_once(variable, value, proof)
 
+    def write_many(
+        self, items: list[tuple[bytes, bytes]]
+    ) -> list[Exception | None]:
+        """Batched write of distinct, password-free variables — one
+        protocol round trip per phase for the whole batch
+        (:meth:`bftkv_tpu.protocol.client.Client.write_many`).
+        Password-protected variables need per-variable TPA proofs; use
+        :meth:`write` for those."""
+        return self.client.write_many(items)
+
+    def read_many(self, variables: list[bytes]) -> list:
+        """Batched read of password-free variables; one entry per
+        variable — value bytes, ``None``, or the per-item error."""
+        return self.client.read_many(variables)
+
     def read(self, variable: bytes, password: str = "") -> bytes | None:
         proof = None
         key = None

@@ -167,6 +167,14 @@ def main(argv: list[str] | None = None) -> int:
             p.add_argument("value", nargs="?")
         p.add_argument("--password", default="")
 
+    p = sub.add_parser("writemany")
+    p.add_argument("--file", default="-",
+                   help="lines of variable=value (default stdin); batched "
+                        "through the write_many pipeline")
+
+    p = sub.add_parser("readmany")
+    p.add_argument("variables", nargs="+")
+
     p = sub.add_parser("ca")
     p.add_argument("caname")
     p.add_argument("--key", required=True, help="PKCS#8 private key file")
@@ -218,6 +226,64 @@ def main(argv: list[str] | None = None) -> int:
         else:
             a.write_once(args.variable.encode(), value, args.password)
         print("ok", file=sys.stderr)
+    elif args.cmd == "writemany":
+        src = (
+            sys.stdin.buffer
+            if args.file == "-"
+            else open(args.file, "rb")
+        )
+        items = []
+        seen: set[bytes] = set()
+        dup_errs: list[str] = []
+        with src:
+            for line in src.read().splitlines():
+                if not line.strip():
+                    continue
+                var, sep, value = line.partition(b"=")
+                if not sep or not var:
+                    # A typoed line must not silently write b"" (or an
+                    # empty variable name) into the store.
+                    dup_errs.append(
+                        f"{line.decode(errors='replace')!r}: "
+                        "expected variable=value"
+                    )
+                    continue
+                if var in seen:
+                    # write_many forbids duplicate variables (they
+                    # would equivocate at the same timestamp); report
+                    # per line instead of crashing on the ValueError.
+                    dup_errs.append(
+                        f"{var.decode(errors='replace')}: duplicate in batch"
+                    )
+                    continue
+                seen.add(var)
+                items.append((var, value))
+        errs = a.write_many(items)
+        rc = 1 if dup_errs else 0
+        for msg in dup_errs:
+            print(msg, file=sys.stderr)
+        for (var, _v), err in zip(items, errs):
+            if err is not None:
+                print(f"{var.decode(errors='replace')}: {err}", file=sys.stderr)
+                rc = 1
+        print(f"{sum(e is None for e in errs)}/{len(items)} written",
+              file=sys.stderr)
+        return rc
+    elif args.cmd == "readmany":
+        got = a.read_many([v.encode() for v in args.variables])
+        rc = 0
+        for var, res in zip(args.variables, got):
+            if isinstance(res, bytes):
+                sys.stdout.buffer.write(var.encode() + b"=" + res + b"\n")
+            elif res is None:
+                # Match the single `read` command: missing is an error,
+                # distinct from a stored-but-empty value.
+                print(f"{var}: not found", file=sys.stderr)
+                rc = 1
+            else:
+                print(f"{var}: {res}", file=sys.stderr)
+                rc = 1
+        return rc
     elif args.cmd == "ca":
         key = _load_ca_key(args.key)
         a.distribute(args.caname, key)

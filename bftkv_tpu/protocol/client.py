@@ -477,6 +477,12 @@ class Client(Protocol):
         reqs = [pkt.serialize(v, None, 0, None, proof) for v in variables]
         ms: list[dict] = [{} for _ in range(n)]
         fails: list[list] = [[] for _ in range(n)]
+        # Per-item result frozen at FIRST threshold success, like the
+        # single path's early delivery: a later fabricated higher-t
+        # response from one Byzantine replica must not retroactively
+        # un-resolve an item (the single path is merely order-lucky
+        # here; freezing makes the batch deterministic).
+        resolved: list[tuple[bytes | None, int] | None] = [None] * n
 
         with metrics.timer("client.read_many.latency"):
 
@@ -503,6 +509,13 @@ class Client(Protocol):
                     )
                     if err is not None:
                         fails[k].append(err)
+                    elif resolved[k] is None:
+                        try:
+                            resolved[k] = self._max_timestamped_value(
+                                ms[k], q
+                            )
+                        except _InProgress:
+                            pass
                 return False  # consume the full quorum, as _read_worker does
 
             self.tr.multicast(
@@ -512,11 +525,20 @@ class Client(Protocol):
             results: list = []
             winners: list[tuple[int, bytes | None, int]] = []
             for k in range(n):
-                try:
-                    value, maxt = self._max_timestamped_value(ms[k], q)
+                if resolved[k] is None:
+                    # Complete fan-out: fall back past fabricated lone
+                    # high-t buckets (see _resolve_complete_fanout).
+                    try:
+                        resolved[k] = self._resolve_complete_fanout(
+                            ms[k], q
+                        )
+                    except _InProgress:
+                        pass
+                if resolved[k] is not None:
+                    value, maxt = resolved[k]
                     results.append(value)
                     winners.append((k, value, maxt))
-                except _InProgress:
+                else:
                     results.append(
                         majority_error(
                             [e for e in fails[k] if e is not None],
@@ -548,7 +570,6 @@ class Client(Protocol):
         # exactly the packets it is missing (a union batch would make
         # every stale node re-verify the whole batch: O(B²) work).
         per_node: dict[int, tuple[object, list[bytes]]] = {}
-        repaired = 0
         for _k, value, maxt in winners:
             if not value:
                 continue
@@ -558,14 +579,17 @@ class Client(Protocol):
                 continue
             have = {sv.node.id for sv in bucket}
             stale = [nd for nd in q.nodes() if nd.id not in have]
-            if stale:
-                repaired += 1
-                for nd in stale:
-                    per_node.setdefault(nd.id, (nd, []))[1].append(
-                        bucket[0].packet
-                    )
+            for nd in stale:
+                per_node.setdefault(nd.id, (nd, []))[1].append(
+                    bucket[0].packet
+                )
         if per_node:
-            metrics.incr("client.read.repair", repaired)
+            # Same unit as the single path: one count per (item, stale
+            # node) send, so mixed traffic sums meaningfully.
+            metrics.incr(
+                "client.read.repair",
+                sum(len(pkts) for _nd, pkts in per_node.values()),
+            )
             peers = [nd for nd, _pkts in per_node.values()]
             payloads = [
                 pkt.serialize_list(pkts) for _nd, pkts in per_node.values()
@@ -636,6 +660,14 @@ class Client(Protocol):
             return False  # go through all members of the quorum
 
         self.tr.multicast(tp.READ, q.nodes(), req, cb)
+        if not done:
+            # Complete fan-out: fall back past fabricated lone high-t
+            # buckets (see _resolve_complete_fanout).
+            try:
+                value, maxt = self._resolve_complete_fanout(m, q)
+                deliver(value, None)
+            except _InProgress:
+                pass
         deliver(None, ERR_INSUFFICIENT_NUMBER_OF_RESPONSES)
         self._revoke_on_read(m)
         if value:
@@ -672,6 +704,48 @@ class Client(Protocol):
         for val, svl in m[maxt].items():
             if q.is_threshold([sv.node for sv in svl]):
                 return (val or None), maxt
+        raise _InProgress
+
+    def _resolve_complete_fanout(self, m, q) -> tuple[bytes | None, int]:
+        """Complete-fan-out fallback, timestamps descending: a bucket
+        wins by responder threshold (the reference's only rule) or by a
+        *sufficient collective signature* on its packet.
+
+        The reference checks only the global max timestamp, so a single
+        Byzantine replica answering with an unsigned fabricated higher
+        t fails the read whenever its response arrives before the
+        honest threshold forms (client.go:189-205).  Responder
+        thresholds alone cannot close that gap: the write quorum's
+        read-class components commit at f+1 acks, so a *committed*
+        newest write may have a single honest holder and look exactly
+        like the liar's lone bucket.  The collective signature is the
+        discriminator — it cryptographically proves a sign quorum
+        endorsed <x,v,t>, so accepting it (and then write-backing it)
+        completes an in-flight write rather than serving a fabrication;
+        a liar cannot forge it.  Verification batches on device like
+        every other ss check.
+        """
+        qa = self.qs.choose_quorum(qm.AUTH)
+        for t in sorted(m, reverse=True):
+            for val, svl in m[t].items():
+                if q.is_threshold([sv.node for sv in svl]):
+                    return (val or None), t
+            if t == 0:
+                continue
+            for val, svl in m[t].items():
+                for sv in svl:
+                    if sv.ss is None or not sv.packet:
+                        continue
+                    try:
+                        self.crypt.collective.verify(
+                            pkt.tbss(sv.packet),
+                            sv.ss,
+                            qa,
+                            self.crypt.keyring,
+                        )
+                        return (val or None), t
+                    except Exception:
+                        continue
         raise _InProgress
 
     def _write_back(self, universe, m, value: bytes, t: int) -> None:

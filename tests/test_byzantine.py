@@ -104,6 +104,74 @@ def test_honest_batch_write_survives_colluders(mal_cluster):
     assert honest.read(b"sane_batch/0") == b"updated batch value 0"
 
 
+def test_high_t_liar_cannot_starve_reads(mal_cluster):
+    """A replica answering reads with an unsigned fabricated higher-t
+    value must not fail the read once the full fan-out is in: the
+    highest *threshold-reaching* timestamp wins (the liar's lone bucket
+    never reaches threshold).  The reference only checks the global max
+    t, so there this liar starves reads whenever its response arrives
+    early — a liveness (not safety) gap this framework closes."""
+    from bftkv_tpu import packet as pkt
+
+    c, _ = mal_cluster
+    honest = c.clients[1]
+    honest.write(b"liar_var", b"the truth")
+    honest.write_many([(b"liar_batch/%d" % i, b"t-%d" % i) for i in range(4)])
+
+    victim = c.storage_servers[0]
+    orig_read_item = victim._read_item
+    orig_batch_read = victim._batch_read
+
+    def lying_read_item(variable, proof):
+        return pkt.serialize(variable, b"FORGED", 2**40, None, None)
+
+    def lying_batch_read(req, peer, sender):
+        items = pkt.parse_list(req)
+        fake = pkt.serialize(b"x", b"FORGED", 2**40, None, None)
+        return pkt.serialize_results([(None, fake)] * len(items))
+
+    victim._read_item = lying_read_item
+    victim._batch_read = lying_batch_read
+    try:
+        for _ in range(5):  # deterministic regardless of arrival order
+            assert honest.read(b"liar_var") == b"the truth"
+            got = honest.read_many([b"liar_batch/%d" % i for i in range(4)])
+            assert got == [b"t-%d" % i for i in range(4)]
+    finally:
+        victim._read_item = orig_read_item
+        victim._batch_read = orig_batch_read
+
+
+def test_lone_signed_newest_value_wins_over_stale_threshold(mal_cluster):
+    """One replica holding the newest value with its *completed
+    collective signature* beats a stale threshold: the reader accepts
+    the cryptographically quorum-endorsed packet and completes the
+    in-flight write rather than serving (or failing to) the old value.
+    An unsigned fabrication in the same position is rejected (see
+    test_high_t_liar_cannot_starve_reads)."""
+    from bftkv_tpu import packet as pkt
+
+    c, _ = mal_cluster
+    honest = c.clients[1]
+    honest.write(b"ur_var", b"old")
+    honest.write(b"ur_var", b"newest")
+
+    # Simulate under-replication of the newest write: every READ-quorum
+    # replica except one is rolled back to the old committed state.
+    keepers = c.storage_servers
+    newest_raw = keepers[0].storage.read(b"ur_var", 0)
+    np_ = pkt.parse(newest_raw)
+    assert np_.value == b"newest" and np_.ss is not None and np_.ss.completed
+    for srv in keepers[1:]:
+        old_raw = srv.storage.read(b"ur_var", np_.t - 1)
+        srv.storage.write(b"ur_var", np_.t, old_raw)  # shadow newest
+    # Their latest is now the old value again (at the old timestamp
+    # semantics: latest = max t, so rewrite under t with old content).
+    got = honest.read(b"ur_var")
+    assert got == b"newest", got
+    assert honest.read_many([b"ur_var"]) == [b"newest"]
+
+
 def test_same_uid_may_overwrite(mal_cluster):
     """TOFU allows a different key with the SAME uid to overwrite
     (reference: server.go:329-337 — id *or* uid match; mal_test.go

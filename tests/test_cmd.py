@@ -92,6 +92,23 @@ def test_bftrw_write_read_across_processes(cluster):
     assert r.stdout == b"hello from bftrw"
 
 
+def test_bftrw_writemany_readmany(cluster):
+    home = os.path.join(cluster["keys"], "u01")
+    lines = b"\n".join(b"bulk/%d=value-%d" % (i, i) for i in range(5))
+    w = run_cmd(
+        ["bftkv_tpu.cmd.bftrw", "--home", home, "writemany"], input=lines
+    )
+    assert w.returncode == 0, w.stderr.decode()
+    assert b"5/5 written" in w.stderr
+    r = run_cmd(
+        ["bftkv_tpu.cmd.bftrw", "--home", home, "readmany"]
+        + ["bulk/%d" % i for i in range(5)]
+    )
+    assert r.returncode == 0, r.stderr.decode()
+    for i in range(5):
+        assert b"bulk/%d=value-%d" % (i, i) in r.stdout
+
+
 def test_daemon_client_api(cluster):
     # The daemon's own client writes through the quorum...
     req = urllib.request.Request(
