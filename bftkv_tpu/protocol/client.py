@@ -506,6 +506,7 @@ class Client(Protocol):
                     err = self._process_response(
                         tp.MulticastResponse(res.peer, payload or None, None),
                         ms[k],
+                        variables[k],
                     )
                     if err is not None:
                         fails[k].append(err)
@@ -522,18 +523,28 @@ class Client(Protocol):
                 tp.BATCH_READ, q.nodes(), pkt.serialize_list(reqs), cb
             )
 
+            # Complete fan-out: fall back past fabricated lone high-t
+            # buckets, one device batch for every candidate signature
+            # across the whole batch (see _resolve_complete_fanout_many).
+            pending_ms = [
+                ms[k] for k in range(n) if resolved[k] is None
+            ]
+            if pending_ms:
+                try:
+                    late = iter(
+                        self._resolve_complete_fanout_many(pending_ms, q)
+                    )
+                    for k in range(n):
+                        if resolved[k] is None:
+                            resolved[k] = next(late)
+                except Exception as e:
+                    for k in range(n):
+                        if resolved[k] is None:
+                            fails[k].append(e)
+
             results: list = []
             winners: list[tuple[int, bytes | None, int]] = []
             for k in range(n):
-                if resolved[k] is None:
-                    # Complete fan-out: fall back past fabricated lone
-                    # high-t buckets (see _resolve_complete_fanout).
-                    try:
-                        resolved[k] = self._resolve_complete_fanout(
-                            ms[k], q
-                        )
-                    except _InProgress:
-                        pass
                 if resolved[k] is not None:
                     value, maxt = resolved[k]
                     results.append(value)
@@ -612,7 +623,7 @@ class Client(Protocol):
 
             worker = threading.Thread(
                 target=self._read_worker,
-                args=(q, req, ch),
+                args=(q, req, ch, variable),
                 daemon=True,
             )
             worker.start()
@@ -621,7 +632,7 @@ class Client(Protocol):
                 raise err
             return value
 
-    def _read_worker(self, q, req: bytes, ch) -> None:
+    def _read_worker(self, q, req: bytes, ch, variable: bytes) -> None:
         m: dict[int, dict[bytes, list[_SignedValue]]] = {}
         done = False
         value = None
@@ -637,7 +648,7 @@ class Client(Protocol):
 
         def cb(res: tp.MulticastResponse) -> bool:
             nonlocal value, maxt
-            err = self._process_response(res, m)
+            err = self._process_response(res, m, variable)
             if err is None:
                 if not done:
                     try:
@@ -662,20 +673,36 @@ class Client(Protocol):
         self.tr.multicast(tp.READ, q.nodes(), req, cb)
         if not done:
             # Complete fan-out: fall back past fabricated lone high-t
-            # buckets (see _resolve_complete_fanout).
+            # buckets (see _resolve_complete_fanout_many).
             try:
-                value, maxt = self._resolve_complete_fanout(m, q)
-                deliver(value, None)
-            except _InProgress:
-                pass
+                (res0,) = self._resolve_complete_fanout_many([m], q)
+                if res0 is not None:
+                    value, maxt = res0
+                    deliver(value, None)
+            except Exception as e:
+                # The worker must ALWAYS deliver: an exception here
+                # (e.g. quorum recomputation mid-read) would otherwise
+                # strand read() on ch.get() forever.
+                deliver(None, e)
         deliver(None, ERR_INSUFFICIENT_NUMBER_OF_RESPONSES)
         self._revoke_on_read(m)
         if value:
             self._write_back(q.nodes(), m, value, maxt)
 
     @staticmethod
-    def _process_response(res: tp.MulticastResponse, m) -> Exception | None:
-        """Bucket one response by (t, value) (reference: client.go:207-230)."""
+    def _process_response(
+        res: tp.MulticastResponse, m, variable: bytes | None = None
+    ) -> Exception | None:
+        """Bucket one response by (t, value) (reference: client.go:207-230).
+
+        A non-empty response whose packet names a *different* variable
+        is an invalid response, not a bucket entry: collective
+        signatures bind <x, v, t>, so an unchecked x would let one
+        Byzantine replica answer read(x) with a genuinely-signed packet
+        for some other variable y and have the complete-fan-out
+        fallback serve y's value for x (the reference never accepts
+        below-threshold buckets, so it never needed this check).
+        """
         if res.err is not None:
             return res.err
         val = None
@@ -687,6 +714,8 @@ class Client(Protocol):
                 p = pkt.parse(raw)
             except Exception as e:
                 return e
+            if variable is not None and (p.variable or b"") != variable:
+                return ERR_MALFORMED_REQUEST
             val, t, sig, ss = p.value, p.t, p.sig, p.ss
         vl = m.setdefault(t, {})
         vl.setdefault(val or b"", []).append(
@@ -706,10 +735,14 @@ class Client(Protocol):
                 return (val or None), maxt
         raise _InProgress
 
-    def _resolve_complete_fanout(self, m, q) -> tuple[bytes | None, int]:
-        """Complete-fan-out fallback, timestamps descending: a bucket
-        wins by responder threshold (the reference's only rule) or by a
-        *sufficient collective signature* on its packet.
+    def _resolve_complete_fanout_many(
+        self, ms: list[dict], q
+    ) -> list[tuple[bytes | None, int] | None]:
+        """Complete-fan-out fallback for a list of response maps,
+        timestamps descending per item: a bucket wins by responder
+        threshold (the reference's only rule) or by a *sufficient
+        collective signature* on its packet; all candidate signatures
+        across all items verify in ONE device batch (verify_many).
 
         The reference checks only the global max timestamp, so a single
         Byzantine replica answering with an unsigned fabricated higher
@@ -720,33 +753,50 @@ class Client(Protocol):
         newest write may have a single honest holder and look exactly
         like the liar's lone bucket.  The collective signature is the
         discriminator — it cryptographically proves a sign quorum
-        endorsed <x,v,t>, so accepting it (and then write-backing it)
-        completes an in-flight write rather than serving a fabrication;
-        a liar cannot forge it.  Verification batches on device like
-        every other ss check.
+        endorsed <x,v,t> (and _process_response has already bound the
+        packet's variable to the one requested), so accepting it — and
+        then write-backing it — completes an in-flight write rather
+        than serving a fabrication; a liar cannot forge it.
         """
-        qa = self.qs.choose_quorum(qm.AUTH)
-        for t in sorted(m, reverse=True):
-            for val, svl in m[t].items():
-                if q.is_threshold([sv.node for sv in svl]):
-                    return (val or None), t
-            if t == 0:
-                continue
-            for val, svl in m[t].items():
-                for sv in svl:
-                    if sv.ss is None or not sv.packet:
-                        continue
-                    try:
-                        self.crypt.collective.verify(
-                            pkt.tbss(sv.packet),
-                            sv.ss,
-                            qa,
-                            self.crypt.keyring,
-                        )
-                        return (val or None), t
-                    except Exception:
-                        continue
-        raise _InProgress
+        resolved: list[tuple[bytes | None, int] | None] = [None] * len(ms)
+        jobs: list[tuple[bytes, pkt.SignaturePacket]] = []
+        meta: list[tuple[int, int, bytes]] = []  # (item, t, val)
+        sig_won: list[bool] = [False] * len(ms)
+        for k, m in enumerate(ms):
+            # Highest-t bucket that wins by responder threshold...
+            t_thr = -1
+            for t in sorted(m, reverse=True):
+                for val, svl in m[t].items():
+                    if q.is_threshold([sv.node for sv in svl]):
+                        resolved[k] = ((val or None), t)
+                        t_thr = t
+                        break
+                if t_thr >= 0:
+                    break
+            # ...but a *signed* candidate at a strictly newer t beats
+            # it (ordering matters: the in-flight newest write sits
+            # above the stale-but-threshold-reaching previous value).
+            for t in sorted(m, reverse=True):
+                if t <= max(t_thr, 0):
+                    break
+                for val, svl in m[t].items():
+                    for sv in svl:
+                        if sv.ss is None or not sv.packet:
+                            continue
+                        jobs.append((pkt.tbss(sv.packet), sv.ss))
+                        meta.append((k, t, val))
+        if jobs:
+            qa = self.qs.choose_quorum(qm.AUTH)
+            errs = self.crypt.collective.verify_many(
+                jobs, qa, self.crypt.keyring
+            )
+            # meta is ordered highest-t first per item, so the first
+            # verified candidate per item is the freshest.
+            for (k, t, val), err in zip(meta, errs):
+                if err is None and not sig_won[k]:
+                    resolved[k] = ((val or None), t)
+                    sig_won[k] = True
+        return resolved
 
     def _write_back(self, universe, m, value: bytes, t: int) -> None:
         """Read-repair: push the winning packet to every node that did

@@ -169,6 +169,24 @@ def test_read_many_repairs_stale_replica(cluster):
     assert healed, "stale replica was not repaired by read_many"
 
 
+def test_batch_pipeline_at_64_replicas():
+    """BASELINE-scale smoke: the batch pipeline through a 64-replica +
+    8-storage-node universe (1024-bit keys keep the host-crypto CPU
+    lane tolerable).  Catches scale-only regressions — quorum
+    construction, fan-out sizing, per-item accounting — that 4-node
+    clusters cannot."""
+    c = start_cluster(64, 1, 8, bits=1024)
+    try:
+        cl = c.clients[0]
+        items = [(b"s64/%d" % i, b"v%d" % i) for i in range(8)]
+        assert cl.write_many(items) == [None] * 8
+        assert cl.read_many([v for v, _ in items]) == [
+            val for _, val in items
+        ]
+    finally:
+        c.stop()
+
+
 def test_write_many_over_http():
     """One batched round over real localhost HTTP sockets."""
     c = start_cluster(4, 1, 4, transport="http")

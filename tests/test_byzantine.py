@@ -172,6 +172,40 @@ def test_lone_signed_newest_value_wins_over_stale_threshold(mal_cluster):
     assert honest.read_many([b"ur_var"]) == [b"newest"]
 
 
+def test_signed_other_variable_cannot_substitute(mal_cluster):
+    """A Byzantine replica answering read(x) with a *genuinely signed*
+    packet for a different variable y (higher t) must not have y's
+    value served for x: responses are bound to the requested variable
+    before any bucket — threshold or signature — can accept them."""
+    from bftkv_tpu import packet as pkt
+
+    c, _ = mal_cluster
+    honest = c.clients[1]
+    honest.write(b"sub_x", b"x-value")
+    for _ in range(3):  # drive y's timestamp above x's
+        honest.write(b"sub_y", b"y-value")
+
+    victim = c.storage_servers[0]
+    y_packet = victim.storage.read(b"sub_y", 0)
+    assert pkt.parse(y_packet).t > pkt.parse(
+        victim.storage.read(b"sub_x", 0)
+    ).t
+    orig = victim._read_item
+
+    def substituting_read_item(variable, proof):
+        if variable == b"sub_x":
+            return y_packet  # genuine quorum-signed packet — for y
+        return orig(variable, proof)
+
+    victim._read_item = substituting_read_item
+    try:
+        for _ in range(5):
+            assert honest.read(b"sub_x") == b"x-value"
+            assert honest.read_many([b"sub_x"]) == [b"x-value"]
+    finally:
+        victim._read_item = orig
+
+
 def test_same_uid_may_overwrite(mal_cluster):
     """TOFU allows a different key with the SAME uid to overwrite
     (reference: server.go:329-337 — id *or* uid match; mal_test.go
