@@ -15,6 +15,7 @@ is LRU-bounded since moduli can be influenced by remote peers.
 
 from __future__ import annotations
 
+import logging
 import os
 from collections import OrderedDict
 
@@ -70,6 +71,39 @@ class BatchModExp:
 
         nlimbs = limb.nlimbs_for_bits(n.bit_length())
         max_e = max(e for _, e in pairs)
+
+        # Prefer the RNS windowed-modexp kernel (~10x the limb kernel at
+        # batch): it covers moduli/exponents up to the context width.
+        # Sub-2^12 primes cannot fund a 4096-bit base pair, so wider
+        # operands (threshold-RSA fragment exponents grow past the key
+        # size per tree level, rsa.go:97-117) stay on the limb path.
+        width = max(n.bit_length(), max_e.bit_length())
+        for nb in (1024, 2048):
+            if width <= nb:
+                from bftkv_tpu.ops import rns
+
+                try:
+                    vals = rns.power_mod_rns(
+                        [b for b, _ in pairs],
+                        [e for _, e in pairs],
+                        [n] * len(pairs),
+                        n_bits=nb,
+                    )
+                except Exception:
+                    # power_mod_rns signals every *legitimately*
+                    # incapable input by returning None; an exception
+                    # is an unexpected defect — degrade, but loudly.
+                    from bftkv_tpu.metrics import registry as metrics
+
+                    metrics.incr("modexp.rns_fallback")
+                    logging.getLogger(__name__).exception(
+                        "RNS modexp failed; falling back to limb kernel"
+                    )
+                    vals = None
+                if vals is not None:
+                    return vals
+                break  # RNS-incapable modulus: fall through to limb
+
         e_limbs = max(limb.nlimbs_for_bits(max_e.bit_length()), 1)
         if e_limbs > self.MAX_EXP_LIMBS:
             return [pow(b % n, e, n) for b, e in pairs]

@@ -36,6 +36,7 @@ from bftkv_tpu.errors import (
     ERR_INSUFFICIENT_NUMBER_OF_SECRETS,
     ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES,
     ERR_INSUFFICIENT_NUMBER_OF_VALID_RESPONSES,
+    ERR_INVALID_RESPONSE,
     ERR_INVALID_TIMESTAMP,
     ERR_MALFORMED_REQUEST,
     ERR_NO_AUTHENTICATION_DATA,
@@ -526,21 +527,17 @@ class Client(Protocol):
             # Complete fan-out: fall back past fabricated lone high-t
             # buckets, one device batch for every candidate signature
             # across the whole batch (see _resolve_complete_fanout_many).
-            pending_ms = [
-                ms[k] for k in range(n) if resolved[k] is None
-            ]
-            if pending_ms:
+            pending = [k for k in range(n) if resolved[k] is None]
+            if pending:
                 try:
-                    late = iter(
-                        self._resolve_complete_fanout_many(pending_ms, q)
+                    late = self._resolve_complete_fanout_many(
+                        [ms[k] for k in pending], q
                     )
-                    for k in range(n):
-                        if resolved[k] is None:
-                            resolved[k] = next(late)
+                    for k, r in zip(pending, late):
+                        resolved[k] = r
                 except Exception as e:
-                    for k in range(n):
-                        if resolved[k] is None:
-                            fails[k].append(e)
+                    for k in pending:
+                        fails[k].append(e)
 
             results: list = []
             winners: list[tuple[int, bytes | None, int]] = []
@@ -715,7 +712,7 @@ class Client(Protocol):
             except Exception as e:
                 return e
             if variable is not None and (p.variable or b"") != variable:
-                return ERR_MALFORMED_REQUEST
+                return ERR_INVALID_RESPONSE
             val, t, sig, ss = p.value, p.t, p.sig, p.ss
         vl = m.setdefault(t, {})
         vl.setdefault(val or b"", []).append(
@@ -786,10 +783,16 @@ class Client(Protocol):
                         jobs.append((pkt.tbss(sv.packet), sv.ss))
                         meta.append((k, t, val))
         if jobs:
-            qa = self.qs.choose_quorum(qm.AUTH)
-            errs = self.crypt.collective.verify_many(
-                jobs, qa, self.crypt.keyring
-            )
+            try:
+                qa = self.qs.choose_quorum(qm.AUTH)
+                errs = self.crypt.collective.verify_many(
+                    jobs, qa, self.crypt.keyring
+                )
+            except Exception:
+                # Verification machinery failing must not discard the
+                # threshold resolutions already computed above — those
+                # items' reads are valid regardless of the candidates.
+                return resolved
             # meta is ordered highest-t first per item, so the first
             # verified candidate per item is the freshest.
             for (k, t, val), err in zip(meta, errs):
