@@ -78,31 +78,32 @@ class BatchModExp:
         # operands (threshold-RSA fragment exponents grow past the key
         # size per tree level, rsa.go:97-117) stay on the limb path.
         width = max(n.bit_length(), max_e.bit_length())
-        for nb in (1024, 2048):
-            if width <= nb:
-                from bftkv_tpu.ops import rns
+        nb = next((w for w in (1024, 2048) if width <= w), None)
+        if nb is not None:
+            from bftkv_tpu.ops import rns
 
-                try:
-                    vals = rns.power_mod_rns(
-                        [b for b, _ in pairs],
-                        [e for _, e in pairs],
-                        [n] * len(pairs),
-                        n_bits=nb,
-                    )
-                except Exception:
-                    # power_mod_rns signals every *legitimately*
-                    # incapable input by returning None; an exception
-                    # is an unexpected defect — degrade, but loudly.
-                    from bftkv_tpu.metrics import registry as metrics
+            try:
+                vals = rns.power_mod_rns(
+                    [b for b, _ in pairs],
+                    [e for _, e in pairs],
+                    [n] * len(pairs),
+                    n_bits=nb,
+                )
+            except Exception:
+                # power_mod_rns signals every *legitimately* incapable
+                # input by returning None; an exception is an
+                # unexpected defect — degrade, but loudly.
+                from bftkv_tpu.metrics import registry as metrics
 
-                    metrics.incr("modexp.rns_fallback")
-                    logging.getLogger(__name__).exception(
-                        "RNS modexp failed; falling back to limb kernel"
-                    )
-                    vals = None
-                if vals is not None:
-                    return vals
-                break  # RNS-incapable modulus: fall through to limb
+                metrics.incr("modexp.rns_error")
+                logging.getLogger(__name__).exception(
+                    "RNS modexp failed; falling back to limb kernel"
+                )
+                vals = None
+            if vals is not None:
+                return vals
+            # else: RNS-incapable modulus (None) or logged error —
+            # fall through to the limb path either way.
 
         e_limbs = max(limb.nlimbs_for_bits(max_e.bit_length()), 1)
         if e_limbs > self.MAX_EXP_LIMBS:

@@ -228,18 +228,23 @@ def _mulmod_r(a, b):
 
 
 def _matmul_f32(x, m_split):
-    """Exact Σ_i x[i]·M[i,j] via f32 MXU matmuls.
+    """Exact Σ_i x[i]·M[i,j] via bf16 MXU matmuls with f32 accumulate.
 
     ``x`` (T,rows) f32 integral < 2^12, split into 6-bit halves; the
-    matrix is pre-split. Partial sums < rows·63·63·... each partial
-    product < 2^12, summed over ≤ 400 rows < 2^21 — exact in f32.
-    Returns (s_ll, s_mid, s_hh).
+    matrix is pre-split.  Every operand is < 64, which bf16 represents
+    exactly (8 significant bits), and the MXU multiplies bf16 natively
+    into an f32 accumulator — one systolic pass per dot instead of
+    XLA's multi-pass f32 emulation.  Partial products < 2^12, summed
+    over ≤ 400 rows < 2^21 — exact.  Returns (s_ll, s_mid, s_hh).
     """
     mlo, mhi = m_split
     xlo = x - jnp.floor(x * np.float32(1 / 64)) * 64  # x & 63, f32-exact
     xhi = jnp.floor(x * np.float32(1 / 64))
     dot = lambda a, b: jax.lax.dot_general(
-        a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        a.astype(jnp.bfloat16),
+        b.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
     )
     s_ll = dot(xlo, mlo)
     s_mid = dot(xlo, mhi) + dot(xhi, mlo)

@@ -18,6 +18,7 @@ reference's closure-over-locals pattern.
 
 from __future__ import annotations
 
+import logging
 import queue
 import threading
 
@@ -45,6 +46,8 @@ from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.protocol import MAX_UINT64, Protocol, Ref, majority_error
 
 __all__ = ["Client", "MAX_UINT64"]
+
+log = logging.getLogger("bftkv_tpu.protocol.client")
 
 
 class _SignedValue:
@@ -792,6 +795,12 @@ class Client(Protocol):
                 # Verification machinery failing must not discard the
                 # threshold resolutions already computed above — those
                 # items' reads are valid regardless of the candidates.
+                # Degrade loudly: this signals broken crypto plumbing,
+                # not a Byzantine peer.
+                metrics.incr("client.read.fallback_verify_error")
+                log.exception(
+                    "complete-fan-out candidate verification failed"
+                )
                 return resolved
             # meta is ordered highest-t first per item, so the first
             # verified candidate per item is the freshest.
