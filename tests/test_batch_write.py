@@ -169,6 +169,64 @@ def test_read_many_repairs_stale_replica(cluster):
     assert healed, "stale replica was not repaired by read_many"
 
 
+def test_concurrent_overlapping_batches_converge(cluster):
+    """Two clients batch-writing OVERLAPPING variables concurrently:
+    every per-item outcome is success or one of the protocol's conflict
+    errors, and afterwards each variable reads back as ONE consistent
+    value on a quorum (a written value — or nothing, when the conflict
+    sank both writers).  Mirrors the reference's concurrency scenarios
+    (rw_test.go) on the batch path."""
+    import threading
+
+    from bftkv_tpu.errors import (
+        ERR_BAD_TIMESTAMP,
+        ERR_EQUIVOCATION,
+        ERR_INSUFFICIENT_NUMBER_OF_RESPONSES,
+        ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES,
+        ERR_INVALID_SIGN_REQUEST,
+    )
+
+    a, b = cluster.clients[0], cluster.clients[1]
+    shared = [b"conc/%d" % i for i in range(6)]
+    outcomes: dict = {}
+
+    def run(tag, client):
+        try:
+            outcomes[tag] = client.write_many(
+                [(v, b"%s-val" % tag) for v in shared]
+            )
+        except Exception as e:  # keep the real failure, not a KeyError
+            outcomes[tag] = e
+
+    ts = [
+        threading.Thread(target=run, args=(t, c))
+        for t, c in ((b"A", a), (b"B", b))
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+
+    conflict_errors = (
+        ERR_BAD_TIMESTAMP,
+        ERR_EQUIVOCATION,
+        ERR_INSUFFICIENT_NUMBER_OF_RESPONSES,
+        ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES,
+        ERR_INVALID_SIGN_REQUEST,
+    )
+    for tag in (b"A", b"B"):
+        assert isinstance(outcomes[tag], list), outcomes[tag]
+        for err in outcomes[tag]:
+            assert err is None or err in conflict_errors, err
+
+    for v in shared:
+        got = a.read(v)
+        # A conflict may sink both writers (neither reaches quorum);
+        # what must never happen is a torn or reader-dependent value.
+        assert got in (b"A-val", b"B-val", None), (v, got)
+        assert b.read(v) == got
+
+
 def test_batch_pipeline_at_64_replicas():
     """BASELINE-scale smoke: the batch pipeline through a 64-replica +
     8-storage-node universe (1024-bit keys keep the host-crypto CPU
