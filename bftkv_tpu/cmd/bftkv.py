@@ -267,6 +267,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--dispatch", action="store_true",
                     help="install the TPU verify/sign dispatchers "
                          "(one replica process per accelerator)")
+    ap.add_argument("--verify-sidecar", default="",
+                    help="host:port of a shared verify sidecar "
+                         "(cmd.verify_sidecar); co-located replicas "
+                         "consolidate their verification batches into "
+                         "one accelerator-owning process — verification "
+                         "is public data, signing stays in-process")
     args = ap.parse_args(argv)
     # Honor JAX_PLATFORMS=cpu *robustly*: ambient sitecustomize may
     # register an accelerator PJRT plugin at interpreter start, and the
@@ -285,7 +291,22 @@ def main(argv: list[str] | None = None) -> int:
 
     server, graph, crypt, qs, tr = build_server(args)
 
-    if args.dispatch:
+    if args.verify_sidecar:
+        from bftkv_tpu.ops import dispatch
+
+        from bftkv_tpu.crypto.remote_verify import RemoteVerifierDomain
+
+        # Verification goes to the sidecar (which owns the accelerator);
+        # this process must NOT also install device crypto — signing
+        # stays host-side unless --dispatch explicitly claims a chip.
+        dispatch.install(
+            dispatch.VerifyDispatcher(
+                verifier=RemoteVerifierDomain(args.verify_sidecar)
+            )
+        )
+        if args.dispatch:
+            dispatch.install_signer()
+    elif args.dispatch:
         from bftkv_tpu.ops import dispatch
 
         dispatch.install()

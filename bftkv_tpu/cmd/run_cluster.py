@@ -43,11 +43,30 @@ def spawn(
     bind_host: str = "",
     join: bool = False,
     client_home: str = "",
+    verify_sidecar: str = "",
     extra_env: dict | None = None,
 ) -> list[subprocess.Popen]:
+    """``verify_sidecar``: "auto" spawns one shared sidecar process and
+    routes every daemon's verification through it (public data only —
+    signing stays per-replica); "host:port" uses an existing one."""
     os.makedirs(db_root, exist_ok=True)
     procs = []
     env = dict(os.environ, **(extra_env or {}))
+    if verify_sidecar == "auto" or verify_sidecar.startswith("auto:"):
+        # "auto" → default port; "auto:HOST:PORT" → explicit address.
+        # (Exact prefix match: a real host named auto*.example resolves
+        # as an existing sidecar, not a spawn request.)
+        _, _, rest = verify_sidecar.partition(":")
+        verify_sidecar = rest or "127.0.0.1:7900"
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "bftkv_tpu.cmd.verify_sidecar",
+                    "--listen", verify_sidecar,
+                ],
+                env=env,
+            )
+        )
     for i, home in enumerate(homes):
         name = os.path.basename(home)
         cmd = [
@@ -65,6 +84,8 @@ def spawn(
             cmd += ["--bind-host", bind_host]
         if join:
             cmd += ["--join"]
+        if verify_sidecar:
+            cmd += ["--verify-sidecar", verify_sidecar]
         procs.append(subprocess.Popen(cmd, env=env))
     return procs
 
@@ -96,6 +117,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--bind-host", default="",
                     help="protocol listen interface override (containers: "
                          "0.0.0.0)")
+    ap.add_argument("--verify-sidecar", default="",
+                    help='"auto" spawns one shared verification sidecar '
+                         "for the fleet; or host:port of an existing one")
     args = ap.parse_args(argv)
 
     homes = server_homes(args.keys)
@@ -104,8 +128,13 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     procs = spawn(homes, args.db_root, storage=args.storage,
                   api_base=args.api_base, api_host=args.api_host,
-                  bind_host=args.bind_host, client_home=args.client_home)
-    print(f"run_cluster: {len(procs)} servers up", flush=True)
+                  bind_host=args.bind_host, client_home=args.client_home,
+                  verify_sidecar=args.verify_sidecar)
+    # The sidecar (if spawned, always first) is an optional optimizer
+    # whose clients fall back to local verification: its death must not
+    # tear down the replica fleet, and it is not a "server".
+    servers = [p for p in procs if "bftkv_tpu.cmd.bftkv" in p.args]
+    print(f"run_cluster: {len(servers)} servers up", flush=True)
 
     stopping = False
 
@@ -115,7 +144,7 @@ def main(argv: list[str] | None = None) -> int:
 
     signal.signal(signal.SIGTERM, handler)
     signal.signal(signal.SIGINT, handler)
-    while not stopping and all(p.poll() is None for p in procs):
+    while not stopping and all(p.poll() is None for p in servers):
         time.sleep(0.5)
     shutdown(procs)
     print("run_cluster: stopped", flush=True)

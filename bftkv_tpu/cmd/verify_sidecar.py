@@ -1,0 +1,149 @@
+"""Shared signature-verification sidecar — one process owns the chip.
+
+SURVEY §5's deployment note: with several replica daemons co-located on
+one accelerator host, per-process dispatchers each pay their own device
+launches, XLA compilations, and transfer overhead.  *Verification* uses
+only public data (message, signature, public key), so — unlike signing,
+which must stay inside each replica's trust domain — all co-located
+daemons can safely forward their verify batches to one sidecar: batches
+from different replicas coalesce in the sidecar's dispatcher into
+shared launches, and only one process compiles/holds the kernels.
+
+Wire protocol (length-prefixed, one request per frame, localhost/unix
+trust assumed — co-located processes on one machine are one failure
+domain already):
+
+    request:  u32 count, then per item chunk(msg) chunk(sig) chunk(n) u32 e
+    response: count bytes of 0/1
+
+Run: ``python -m bftkv_tpu.cmd.verify_sidecar --listen 127.0.0.1:7900``
+Daemons opt in with ``bftkv --verify-sidecar 127.0.0.1:7900``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import io
+import socket
+import socketserver
+import struct
+import sys
+import threading
+
+from bftkv_tpu.packet import read_chunk, write_chunk
+
+__all__ = ["serve", "main", "encode_request", "decode_request"]
+
+
+def encode_request(items: list) -> bytes:
+    """[(message, sig_bytes, PublicKey)] → one request frame body."""
+    buf = io.BytesIO()
+    buf.write(struct.pack(">I", len(items)))
+    for message, sig, key in items:
+        write_chunk(buf, message)
+        write_chunk(buf, sig)
+        n = key.n
+        write_chunk(buf, n.to_bytes((n.bit_length() + 7) // 8 or 1, "big"))
+        buf.write(struct.pack(">I", key.e))
+    return buf.getvalue()
+
+
+def decode_request(body: bytes) -> list:
+    from bftkv_tpu.crypto.rsa import PublicKey
+
+    r = io.BytesIO(body)
+    (count,) = struct.unpack(">I", r.read(4))
+    if count > len(body):  # each item needs headers at minimum
+        raise ValueError("bad count")
+    items = []
+    for _ in range(count):
+        msg = read_chunk(r) or b""
+        sig = read_chunk(r) or b""
+        n = int.from_bytes(read_chunk(r) or b"", "big")
+        (e,) = struct.unpack(">I", r.read(4))
+        items.append((msg, sig, PublicKey(n=n, e=e)))
+    return items
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self) -> None:
+        sock = self.request
+        try:
+            while True:
+                hdr = _recvall(sock, 4)
+                if hdr is None:
+                    return
+                (ln,) = struct.unpack(">I", hdr)
+                if ln > self.server.max_frame:
+                    return  # oversized frame: drop the connection
+                body = _recvall(sock, ln)
+                if body is None:
+                    return
+                claimed = (
+                    struct.unpack(">I", body[:4])[0] if len(body) >= 4 else 0
+                )
+                try:
+                    items = decode_request(body)
+                    ok = self.server.dispatcher.verify(items)
+                    out = bytes(bool(b) for b in ok)
+                except Exception:
+                    # Malformed frame: all-fail response of the claimed
+                    # count keeps the client's accounting aligned (a
+                    # hostile count is already bounded by the frame).
+                    out = bytes(min(claimed, len(body)))
+                sock.sendall(struct.pack(">I", len(out)) + out)
+        except (ConnectionError, OSError):
+            return
+
+
+def _recvall(sock, n: int) -> bytes | None:
+    buf = b""
+    while len(buf) < n:
+        part = sock.recv(n - len(buf))
+        if not part:
+            return None
+        buf += part
+    return buf
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve(
+    listen: str,
+    *,
+    max_batch: int = 4096,
+    max_wait: float | None = None,
+    max_frame: int = 1 << 26,
+):
+    """Start the sidecar; returns (server, thread) for embedding."""
+    from bftkv_tpu.ops import dispatch
+
+    host, _, port = listen.rpartition(":")
+    srv = _Server((host or "127.0.0.1", int(port)), _Handler)
+    kw = {} if max_wait is None else {"max_wait": max_wait}
+    srv.dispatcher = dispatch.VerifyDispatcher(max_batch=max_batch, **kw).start()
+    srv.max_frame = max_frame
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return srv, t
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(description="shared verify sidecar")
+    ap.add_argument("--listen", default="127.0.0.1:7900")
+    ap.add_argument("--max-batch", type=int, default=4096)
+    args = ap.parse_args(argv)
+    srv, t = serve(args.listen, max_batch=args.max_batch)
+    print(f"verify-sidecar: listening on {args.listen}", flush=True)
+    try:
+        t.join()
+    except KeyboardInterrupt:
+        srv.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

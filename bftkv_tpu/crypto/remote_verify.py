@@ -1,0 +1,142 @@
+"""Client side of the shared verify sidecar: a VerifierDomain drop-in.
+
+``RemoteVerifierDomain.verify_batch`` forwards the batch to the sidecar
+(:mod:`bftkv_tpu.cmd.verify_sidecar`) over a persistent localhost
+connection and falls back to the local domain on any transport failure
+— verification must degrade, never break.  Install in a daemon with
+``bftkv --verify-sidecar ADDR`` (the local VerifyDispatcher still
+coalesces the process's own threads; the sidecar's dispatcher then
+coalesces across processes).
+
+Only *verification* is ever remoted: it consumes public data, so
+co-located replicas sharing one sidecar keeps each replica's secrets in
+its own process (SURVEY §5's Byzantine-boundary discipline).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+from bftkv_tpu.cmd.verify_sidecar import encode_request
+from bftkv_tpu.crypto import rsa
+from bftkv_tpu.metrics import registry as metrics
+
+__all__ = ["RemoteVerifierDomain"]
+
+
+class RemoteVerifierDomain:
+    """Forward verify batches to a sidecar; local fallback on failure.
+
+    The default local fallback is a HOST-ONLY verifier: a sidecar-mode
+    daemon deliberately does not own the accelerator (the sidecar
+    does), so its degradation path must not try to initialize one.
+    Pass ``local=`` explicitly for a device-capable fallback.
+    """
+
+    #: After a remote failure, skip the sidecar for this long — a hung
+    #: (connected but unresponsive) sidecar would otherwise stall every
+    #: flush for up to two timeouts, serializing the dispatcher.
+    BREAKER_SECONDS = 30.0
+
+    def __init__(self, addr: str, *, timeout: float = 30.0, local=None):
+        host, _, port = addr.rpartition(":")
+        self._addr = (host or "127.0.0.1", int(port))
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+        self._skip_until = 0.0
+        self.local = local or rsa.VerifierDomain(host_threshold=1 << 30)
+        # The protocol layer reads the crossover off the domain; the
+        # sidecar amortizes launches remotely, so keep the local
+        # VerifierDomain's usual crossover semantics for callers.
+        self.host_threshold = rsa.VerifierDomain.HOST_CROSSOVER
+
+    def _connect(self) -> socket.socket:
+        s = socket.create_connection(self._addr, timeout=self._timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def verify_batch(self, items: list) -> np.ndarray:
+        # Hostile public keys (oversized e, absurd n) must fail closed
+        # per item like the local path — not blow up the whole frame.
+        wire_idx: list[int] = []
+        wire_items: list = []
+        out_all = np.zeros((len(items),), dtype=bool)
+        local_idx: list[int] = []
+        for i, (msg, sig, key) in enumerate(items):
+            if 0 < key.e < (1 << 32) and key.n > 0:
+                wire_idx.append(i)
+                wire_items.append((msg, sig, key))
+            else:
+                local_idx.append(i)
+        for i in local_idx:
+            try:
+                msg, sig, key = items[i]
+                out_all[i] = rsa.verify_host(msg, sig, key)
+            except Exception:
+                out_all[i] = False
+        if not wire_items:
+            return out_all
+        got = self._verify_remote(wire_items)
+        if got is None:
+            metrics.incr("verify.remote_fallback", len(wire_items))
+            got = self.local.verify_batch(wire_items)
+        out_all[np.asarray(wire_idx)] = np.asarray(got, dtype=bool)
+        return out_all
+
+    def _verify_remote(self, items: list) -> np.ndarray | None:
+        if time.monotonic() < self._skip_until:
+            return None
+        body = encode_request(items)
+        frame = struct.pack(">I", len(body)) + body
+        with self._lock:
+            for attempt in range(2):
+                try:
+                    if self._sock is None:
+                        self._sock = self._connect()
+                    self._sock.sendall(frame)
+                    out = self._read_response(len(items))
+                    if out is not None:
+                        metrics.incr("verify.remote", len(items))
+                        return out
+                except (ConnectionError, OSError, struct.error):
+                    pass
+                # Broken pipe / sidecar restart: drop the connection
+                # and retry once on a fresh one before giving up.
+                self._close()
+            self._skip_until = time.monotonic() + self.BREAKER_SECONDS
+            metrics.incr("verify.remote_breaker_open")
+        return None
+
+    def _read_response(self, n: int) -> np.ndarray | None:
+        hdr = self._recvall(4)
+        (ln,) = struct.unpack(">I", hdr)
+        if ln != n:
+            # Sidecar rejected the frame (or protocol skew): local.
+            if ln:
+                self._recvall(ln)
+            return None
+        body = self._recvall(ln)
+        return np.frombuffer(body, dtype=np.uint8).astype(bool)
+
+    def _recvall(self, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            part = self._sock.recv(n - len(buf))
+            if not part:
+                raise ConnectionError("sidecar closed")
+            buf += part
+        return buf
+
+    def _close(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None

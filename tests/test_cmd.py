@@ -72,6 +72,9 @@ def cluster(tmp_path_factory):
     procs = run_cluster.spawn(
         homes, dbs, storage="native", api_base=API_BASE,
         client_home=os.path.join(keys, "u01"), extra_env=ENV,
+        # The whole fleet verifies through one shared sidecar process —
+        # every cmd test below then exercises the sidecar path too.
+        verify_sidecar=f"auto:127.0.0.1:{API_BASE + 99}",
     )
     try:
         for port in (*range(BASE, BASE + 4), *range(RW_BASE, RW_BASE + 4)):
