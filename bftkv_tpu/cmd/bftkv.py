@@ -268,11 +268,17 @@ def main(argv: list[str] | None = None) -> int:
                     help="install the TPU verify/sign dispatchers "
                          "(one replica process per accelerator)")
     ap.add_argument("--verify-sidecar", default="",
-                    help="host:port of a shared verify sidecar "
-                         "(cmd.verify_sidecar); co-located replicas "
-                         "consolidate their verification batches into "
-                         "one accelerator-owning process — verification "
-                         "is public data, signing stays in-process")
+                    help="host:port or unix:/path of a shared verify "
+                         "sidecar (cmd.verify_sidecar); co-located "
+                         "replicas consolidate their verification "
+                         "batches into one accelerator-owning process — "
+                         "verification is public data, signing stays "
+                         "in-process. Prefer unix: (mode-0600 socket); "
+                         "a TCP port can be squatted after a crash")
+    ap.add_argument("--verify-sidecar-secret", default="",
+                    help="file with a shared secret: HMAC-authenticate "
+                         "sidecar frames both ways and fail closed "
+                         "(local verify) on mismatch — use with TCP")
     args = ap.parse_args(argv)
     # Honor JAX_PLATFORMS=cpu *robustly*: ambient sitecustomize may
     # register an accelerator PJRT plugin at interpreter start, and the
@@ -299,9 +305,16 @@ def main(argv: list[str] | None = None) -> int:
         # Verification goes to the sidecar (which owns the accelerator);
         # this process must NOT also install device crypto — signing
         # stays host-side unless --dispatch explicitly claims a chip.
+        secret = None
+        if args.verify_sidecar_secret:
+            from bftkv_tpu.cmd.verify_sidecar import load_secret
+
+            secret = load_secret(args.verify_sidecar_secret)
         dispatch.install(
             dispatch.VerifyDispatcher(
-                verifier=RemoteVerifierDomain(args.verify_sidecar)
+                verifier=RemoteVerifierDomain(
+                    args.verify_sidecar, secret=secret
+                )
             )
         )
         if args.dispatch:

@@ -24,6 +24,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--unsigned-users", type=int, default=0,
                     help="trailing users without quorum certificates (TOFU)")
     ap.add_argument("--bits", type=int, default=2048)
+    ap.add_argument("--alg", default="rsa", choices=["rsa", "p256", "mixed"],
+                    help="identity-key algorithm: RSA-2048, ECDSA P-256, "
+                         "or alternating (BASELINE config 4)")
     ap.add_argument("--base-port", type=int, default=6001)
     ap.add_argument("--rw-base-port", type=int, default=6101)
     ap.add_argument("--server-trust-rw", action="store_true",
@@ -44,6 +47,7 @@ def main(argv: list[str] | None = None) -> int:
         bits=args.bits,
         unsigned_users=args.unsigned_users,
         server_trust_rw=args.server_trust_rw,
+        alg=args.alg,
     )
     os.makedirs(args.out, exist_ok=True)
     for ident in uni.all:

@@ -53,11 +53,16 @@ def spawn(
     procs = []
     env = dict(os.environ, **(extra_env or {}))
     if verify_sidecar == "auto" or verify_sidecar.startswith("auto:"):
-        # "auto" → default port; "auto:HOST:PORT" → explicit address.
-        # (Exact prefix match: a real host named auto*.example resolves
-        # as an existing sidecar, not a spawn request.)
+        # "auto" → a mode-0600 Unix socket under db_root (a TCP port
+        # could be squatted by another local user after a sidecar
+        # crash); "auto:HOST:PORT" / "auto:unix:/path" → explicit
+        # address.  (Exact prefix match: a real host named
+        # auto*.example resolves as an existing sidecar, not a spawn
+        # request.)
         _, _, rest = verify_sidecar.partition(":")
-        verify_sidecar = rest or "127.0.0.1:7900"
+        verify_sidecar = rest or "unix:" + os.path.join(
+            os.path.abspath(db_root), "verify.sock"
+        )
         procs.append(
             subprocess.Popen(
                 [

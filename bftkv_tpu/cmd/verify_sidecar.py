@@ -9,21 +9,41 @@ daemons can safely forward their verify batches to one sidecar: batches
 from different replicas coalesce in the sidecar's dispatcher into
 shared launches, and only one process compiles/holds the kernels.
 
-Wire protocol (length-prefixed, one request per frame, localhost/unix
-trust assumed — co-located processes on one machine are one failure
-domain already):
+Wire protocol (length-prefixed, one request per frame):
 
     request:  u32 count, then per item chunk(msg) chunk(sig) chunk(n) u32 e
     response: count bytes of 0/1
 
-Run: ``python -m bftkv_tpu.cmd.verify_sidecar --listen 127.0.0.1:7900``
-Daemons opt in with ``bftkv --verify-sidecar 127.0.0.1:7900``.
+Failure semantics (deliberate, load-bearing):
+
+- *Malformed frame* (attacker-controlled bytes): all-fail response of
+  the claimed count — the client's accounting stays aligned and hostile
+  input can never manufacture a "valid" verdict.
+- *Internal error* (dispatcher/device failure): **zero-length
+  response** — a count mismatch on the client side, which makes
+  ``RemoteVerifierDomain`` fall back to local verification.  A broken
+  accelerator must degrade to local verify, not masquerade as
+  "all signatures invalid" (a cluster-wide liveness outage).
+
+Trust boundary: verdicts are only as trustworthy as the transport, so
+the recommended deployment is a **Unix domain socket** (``--listen
+unix:/path/sock``, created mode 0600) — a TCP port can be squatted by
+any local user after a sidecar crash, and the client would happily
+reconnect to the impostor.  For TCP, configure a shared secret
+(``--secret-file``): every request and response carries an HMAC-SHA256
+tag and the client fails closed (local verify) on tag mismatch.
+
+Run: ``python -m bftkv_tpu.cmd.verify_sidecar --listen unix:/run/bftkv/verify.sock``
+Daemons opt in with ``bftkv --verify-sidecar unix:/run/bftkv/verify.sock``.
 """
 
 from __future__ import annotations
 
 import argparse
+import hashlib
+import hmac
 import io
+import os
 import socket
 import socketserver
 import struct
@@ -32,7 +52,28 @@ import threading
 
 from bftkv_tpu.packet import read_chunk, write_chunk
 
-__all__ = ["serve", "main", "encode_request", "decode_request"]
+__all__ = [
+    "serve",
+    "main",
+    "encode_request",
+    "decode_request",
+    "request_tag",
+    "response_tag",
+    "TAG_LEN",
+]
+
+TAG_LEN = 32  # HMAC-SHA256
+
+
+def request_tag(secret: bytes, body: bytes) -> bytes:
+    return hmac.new(secret, b"bftkv-sidecar-req" + body, hashlib.sha256).digest()
+
+
+def response_tag(secret: bytes, req_body: bytes, out: bytes) -> bytes:
+    """Tag binds the verdicts to the exact request they answer, so a
+    recorded response for one batch cannot be replayed for another."""
+    h = hashlib.sha256(req_body).digest()
+    return hmac.new(secret, b"bftkv-sidecar-res" + h + out, hashlib.sha256).digest()
 
 
 def encode_request(items: list) -> bytes:
@@ -68,6 +109,7 @@ def decode_request(body: bytes) -> list:
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self) -> None:
         sock = self.request
+        secret = self.server.secret
         try:
             while True:
                 hdr = _recvall(sock, 4)
@@ -79,19 +121,39 @@ class _Handler(socketserver.BaseRequestHandler):
                 body = _recvall(sock, ln)
                 if body is None:
                     return
+                if secret is not None:
+                    # Unauthenticated peer: drop the connection. No
+                    # all-fail reply — an attacker must not be able to
+                    # steer verdicts at all without the secret.
+                    if len(body) < TAG_LEN or not hmac.compare_digest(
+                        body[-TAG_LEN:], request_tag(secret, body[:-TAG_LEN])
+                    ):
+                        return
+                    body = body[:-TAG_LEN]
                 claimed = (
                     struct.unpack(">I", body[:4])[0] if len(body) >= 4 else 0
                 )
                 try:
                     items = decode_request(body)
-                    ok = self.server.dispatcher.verify(items)
-                    out = bytes(bool(b) for b in ok)
                 except Exception:
                     # Malformed frame: all-fail response of the claimed
                     # count keeps the client's accounting aligned (a
                     # hostile count is already bounded by the frame).
                     out = bytes(min(claimed, len(body)))
-                sock.sendall(struct.pack(">I", len(out)) + out)
+                else:
+                    try:
+                        ok = self.server.dispatcher.verify(items)
+                        out = bytes(bool(b) for b in ok)
+                    except Exception:
+                        # Internal failure (dead/hung accelerator, bug):
+                        # zero-length reply = count mismatch = client
+                        # falls back to LOCAL verification.  Never
+                        # fabricate "all invalid" for well-formed input.
+                        out = b""
+                tag = b"" if secret is None or not out else response_tag(
+                    secret, body, out
+                )
+                sock.sendall(struct.pack(">I", len(out) + len(tag)) + out + tag)
         except (ConnectionError, OSError):
             return
 
@@ -111,32 +173,69 @@ class _Server(socketserver.ThreadingTCPServer):
     daemon_threads = True
 
 
+class _UnixServer(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+
+
 def serve(
     listen: str,
     *,
     max_batch: int = 4096,
     max_wait: float | None = None,
     max_frame: int = 1 << 26,
+    secret: bytes | None = None,
 ):
-    """Start the sidecar; returns (server, thread) for embedding."""
+    """Start the sidecar; returns (server, thread) for embedding.
+
+    ``listen`` is ``host:port`` or ``unix:/path/to.sock`` (socket file
+    created mode 0600 — only this uid's processes can obtain verdicts).
+    """
     from bftkv_tpu.ops import dispatch
 
-    host, _, port = listen.rpartition(":")
-    srv = _Server((host or "127.0.0.1", int(port)), _Handler)
+    if listen.startswith("unix:"):
+        path = listen[len("unix:"):]
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        # umask, not post-bind chmod: the socket must never be
+        # world-connectable, even for the bind→chmod window (a peer
+        # that connects in that window keeps its connection).
+        old_umask = os.umask(0o177)
+        try:
+            srv = _UnixServer(path, _Handler)
+        finally:
+            os.umask(old_umask)
+        os.chmod(path, 0o600)
+    else:
+        host, _, port = listen.rpartition(":")
+        srv = _Server((host or "127.0.0.1", int(port)), _Handler)
     kw = {} if max_wait is None else {"max_wait": max_wait}
     srv.dispatcher = dispatch.VerifyDispatcher(max_batch=max_batch, **kw).start()
     srv.max_frame = max_frame
+    srv.secret = secret
     t = threading.Thread(target=srv.serve_forever, daemon=True)
     t.start()
     return srv, t
 
 
+def load_secret(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read().strip()
+
+
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description="shared verify sidecar")
-    ap.add_argument("--listen", default="127.0.0.1:7900")
+    ap.add_argument("--listen", default="127.0.0.1:7900",
+                    help="host:port, or unix:/path/to.sock (recommended: "
+                         "a TCP port can be squatted after a crash)")
     ap.add_argument("--max-batch", type=int, default=4096)
+    ap.add_argument("--secret-file", default="",
+                    help="file holding a shared secret; frames are then "
+                         "HMAC-authenticated both ways (use for TCP)")
     args = ap.parse_args(argv)
-    srv, t = serve(args.listen, max_batch=args.max_batch)
+    secret = load_secret(args.secret_file) if args.secret_file else None
+    srv, t = serve(args.listen, max_batch=args.max_batch, secret=secret)
     print(f"verify-sidecar: listening on {args.listen}", flush=True)
     try:
         t.join()

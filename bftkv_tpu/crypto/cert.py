@@ -10,14 +10,18 @@ CertificateInstance``); trust edges are the embedded signatures
 
 Wire layout (all chunks length-prefixed per ``bftkv_tpu.packet``):
 
-    magic "BCR1" | chunk(n big-endian) | u32 e | chunk(name) |
-    chunk(address) | chunk(uid) | u16 nsigs | nsigs × (u64 signer_id |
-    chunk(sig))
+    RSA:   magic "BCR1" | chunk(n big-endian) | u32 e | chunk(name) |
+           chunk(address) | chunk(uid) | u16 nsigs | nsigs ×
+           (u64 signer_id | chunk(sig))
+    ECDSA: magic "BCR2" | chunk(alg, e.g. b"p256") | chunk(SEC1 point) |
+           chunk(name) | chunk(address) | chunk(uid) | u16 nsigs | ...
 
-The to-be-signed region is everything before ``nsigs``; a signature is a
-PKCS#1 v1.5/SHA-256 signature over it by the signer's key. The node id
-is the first 8 bytes (big-endian) of SHA-256 over the public key — the
-analog of the PGP 64-bit key id.
+The to-be-signed region is everything before ``nsigs``; a signature is
+issued by the signer's key in the signer's own algorithm (PKCS#1
+v1.5/SHA-256 for RSA, 64-byte r‖s ECDSA/SHA-256 for P-256 — matching
+the reference's algorithm-agnostic verify, crypto_pgp.go:310-405). The
+node id is the first 8 bytes (big-endian) of SHA-256 over the public
+key — the analog of the PGP 64-bit key id.
 """
 
 from __future__ import annotations
@@ -32,6 +36,10 @@ from bftkv_tpu.crypto import rsa
 from bftkv_tpu.packet import read_chunk, write_chunk
 
 _MAGIC = b"BCR1"
+_MAGIC_EC = b"BCR2"
+
+ALG_RSA = "rsa"
+ALG_P256 = "p256"
 
 # u16 wire field bounds the signer set; merge()/add_signature enforce it.
 MAX_SIGNATURES = 0xFFFF
@@ -42,6 +50,26 @@ def key_id(n: int, e: int) -> int:
     h.update(n.to_bytes((n.bit_length() + 7) // 8, "big"))
     h.update(struct.pack(">I", e))
     return struct.unpack(">Q", h.digest()[:8])[0]
+
+
+def key_id_ec(alg: str, point: bytes) -> int:
+    h = hashlib.sha256()
+    h.update(alg.encode())
+    h.update(point)
+    return struct.unpack(">Q", h.digest()[:8])[0]
+
+
+def is_ec(key) -> bool:
+    """True for EC key objects (public or private) — the one algorithm
+    dispatch rule every layer shares."""
+    return hasattr(key, "curve")
+
+
+def private_key_id(key) -> int:
+    """Node id for either private-key type (keyring registration)."""
+    if is_ec(key):
+        return key_id_ec(ALG_P256, key.public.marshal())
+    return key_id(key.n, key.e)
 
 
 @dataclass
@@ -56,21 +84,30 @@ class Certificate:
     # signer_id -> signature bytes over tbs(); dict keeps one edge per signer
     signatures: dict[int, bytes] = field(default_factory=dict)
     active: bool = True
+    alg: str = ALG_RSA
+    point: bytes = b""  # SEC1 public point (EC certs; n/e are 0)
 
     # -- identity ---------------------------------------------------------
     @property
     def id(self) -> int:
         # Cached: id backs __hash__/__eq__ and the hot graph/quorum
-        # loops; (n, e) never changes after construction.
+        # loops; the key material never changes after construction.
         cached = self.__dict__.get("_id")
         if cached is None:
-            cached = key_id(self.n, self.e)
+            if self.alg == ALG_RSA:
+                cached = key_id(self.n, self.e)
+            else:
+                cached = key_id_ec(self.alg, self.point)
             self.__dict__["_id"] = cached
         return cached
 
     @property
-    def public_key(self) -> rsa.PublicKey:
-        return rsa.PublicKey(n=self.n, e=self.e)
+    def public_key(self):
+        if self.alg == ALG_RSA:
+            return rsa.PublicKey(n=self.n, e=self.e)
+        from bftkv_tpu.crypto import ecdsa as _ecdsa
+
+        return _ecdsa.public_from_bytes(self.point)
 
     def __hash__(self) -> int:
         return hash(self.id)
@@ -81,10 +118,15 @@ class Certificate:
     # -- serialization ----------------------------------------------------
     def tbs(self) -> bytes:
         buf = io.BytesIO()
-        buf.write(_MAGIC)
-        nb = self.n.to_bytes((self.n.bit_length() + 7) // 8, "big")
-        write_chunk(buf, nb)
-        buf.write(struct.pack(">I", self.e))
+        if self.alg == ALG_RSA:
+            buf.write(_MAGIC)
+            nb = self.n.to_bytes((self.n.bit_length() + 7) // 8, "big")
+            write_chunk(buf, nb)
+            buf.write(struct.pack(">I", self.e))
+        else:
+            buf.write(_MAGIC_EC)
+            write_chunk(buf, self.alg.encode())
+            write_chunk(buf, self.point)
         write_chunk(buf, self.name.encode())
         write_chunk(buf, self.address.encode())
         write_chunk(buf, self.uid.encode())
@@ -112,11 +154,12 @@ class Certificate:
         self.signatures[signer_id] = sig
 
     def verify_signature(self, signer: "Certificate") -> bool:
-        """Check ``signer``'s edge onto this cert."""
+        """Check ``signer``'s edge onto this cert (in the *signer*'s
+        algorithm — reference: crypto_pgp.go:310-405)."""
         sig = self.signatures.get(signer.id)
         if sig is None:
             return False
-        return rsa.verify_host(self.tbs(), sig, signer.public_key)
+        return verify_detached(self.tbs(), sig, signer)
 
     def merge(self, other: "Certificate") -> None:
         """Union the signature sets (reference: crypto_pgp.go:283-305)."""
@@ -130,27 +173,69 @@ class Certificate:
             self.signatures[signer_id] = sig
 
 
-def sign_certificate(cert: Certificate, signer_key: rsa.PrivateKey) -> None:
+def verify_detached(tbs: bytes, sig: bytes, signer: "Certificate") -> bool:
+    """Verify ``sig`` over ``tbs`` in the signer's own algorithm."""
+    try:
+        if signer.alg == ALG_RSA:
+            return rsa.verify_host(tbs, sig, signer.public_key)
+        from bftkv_tpu.crypto import ecdsa as _ecdsa
+
+        return _ecdsa.verify_host(tbs, sig, signer.public_key)
+    except Exception:
+        return False
+
+
+def sign_certificate(cert: Certificate, signer_key) -> None:
     """Add signer's trust edge onto ``cert``
-    (reference: crypto_pgp.go:252-281)."""
-    sig = rsa.sign(cert.tbs(), signer_key)
-    cert.add_signature(key_id(signer_key.n, signer_key.e), sig)
+    (reference: crypto_pgp.go:252-281).  ``signer_key`` is an RSA or an
+    ECDSA private key; the edge is issued in its algorithm."""
+    if is_ec(signer_key):
+        from bftkv_tpu.crypto import ecdsa as _ecdsa
+
+        sig = _ecdsa.sign(cert.tbs(), signer_key)
+    else:
+        sig = rsa.sign(cert.tbs(), signer_key)
+    cert.add_signature(private_key_id(signer_key), sig)
+
+
+def make_ec_certificate(
+    pub, *, name: str = "", address: str = "", uid: str = ""
+) -> Certificate:
+    """Certificate over an :class:`bftkv_tpu.crypto.ecdsa.ECPublicKey`."""
+    return Certificate(
+        n=0, e=0, name=name, address=address, uid=uid,
+        alg=ALG_P256, point=pub.marshal(),
+    )
 
 
 def _parse_one(r: io.BytesIO) -> Certificate | None:
     magic = r.read(4)
     if len(magic) == 0:
         return None
-    if magic != _MAGIC:
+    if magic not in (_MAGIC, _MAGIC_EC):
         raise ERR_MALFORMED_REQUEST
     try:
-        nb = read_chunk(r)
-        if nb is None:
-            raise ERR_MALFORMED_REQUEST
-        eb = r.read(4)
-        if len(eb) < 4:
-            raise ERR_MALFORMED_REQUEST
-        e = struct.unpack(">I", eb)[0]
+        n = e = 0
+        alg, point = ALG_RSA, b""
+        if magic == _MAGIC:
+            nb = read_chunk(r)
+            if nb is None:
+                raise ERR_MALFORMED_REQUEST
+            n = int.from_bytes(nb, "big")
+            eb = r.read(4)
+            if len(eb) < 4:
+                raise ERR_MALFORMED_REQUEST
+            e = struct.unpack(">I", eb)[0]
+        else:
+            alg = (read_chunk(r) or b"").decode()
+            point = read_chunk(r) or b""
+            if alg != ALG_P256:
+                raise ERR_MALFORMED_REQUEST
+            # Validate the point once at the trust boundary so
+            # ``public_key`` on a parsed cert cannot blow up later.
+            from bftkv_tpu.crypto import ecdsa as _ecdsa
+
+            _ecdsa.public_from_bytes(point)
         name = (read_chunk(r) or b"").decode()
         address = (read_chunk(r) or b"").decode()
         uid = (read_chunk(r) or b"").decode()
@@ -170,12 +255,14 @@ def _parse_one(r: io.BytesIO) -> Certificate | None:
         # certificates, never unhandled exceptions.
         raise ERR_MALFORMED_REQUEST from None
     return Certificate(
-        n=int.from_bytes(nb, "big"),
+        n=n,
         e=e,
         name=name,
         address=address,
         uid=uid,
         signatures=sigs,
+        alg=alg,
+        point=point,
     )
 
 

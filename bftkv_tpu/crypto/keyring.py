@@ -18,28 +18,44 @@ from bftkv_tpu.errors import ERR_CERTIFICATE_NOT_FOUND, ERR_KEY_NOT_FOUND
 from bftkv_tpu.packet import read_bigint, write_bigint
 
 _SECMAGIC = b"BSK1"
+_SECMAGIC_EC = b"BSK2"
 
 
-def serialize_private_key(key: rsa.PrivateKey) -> bytes:
+def serialize_private_key(key) -> bytes:
+    """RSA ("BSK1": n,e,d,p,q bigints) or ECDSA P-256 ("BSK2": d)."""
     buf = io.BytesIO()
+    if certmod.is_ec(key):
+        buf.write(_SECMAGIC_EC)
+        write_bigint(buf, key.d)
+        return buf.getvalue()
     buf.write(_SECMAGIC)
     for x in (key.n, key.e, key.d, key.p, key.q):
         write_bigint(buf, x)
     return buf.getvalue()
 
 
-def read_private_key(r: io.BytesIO) -> rsa.PrivateKey | None:
+def read_private_key(r: io.BytesIO):
     """Read one self-delimiting key record from a stream; None at EOF."""
     magic = r.read(4)
     if len(magic) == 0:
         return None
+    if magic == _SECMAGIC_EC:
+        from bftkv_tpu.crypto import ec, ecdsa
+
+        d = read_bigint(r)
+        pt = ec.P256.scalar_base_mult(d)
+        if pt is None:
+            raise ERR_KEY_NOT_FOUND
+        return ecdsa.ECPrivateKey(
+            d=d, public=ecdsa.ECPublicKey(x=pt[0], y=pt[1])
+        )
     if magic != _SECMAGIC:
         raise ERR_KEY_NOT_FOUND
     n, e, d, p, q = (read_bigint(r) for _ in range(5))
     return rsa.PrivateKey(n=n, e=e, d=d, p=p, q=q)
 
 
-def parse_private_key(data: bytes) -> rsa.PrivateKey:
+def parse_private_key(data: bytes):
     key = read_private_key(io.BytesIO(data))
     if key is None:
         raise ERR_KEY_NOT_FOUND
@@ -55,7 +71,7 @@ class Keyring:
     def register(
         self,
         certs: list[certmod.Certificate],
-        priv: rsa.PrivateKey | None = None,
+        priv=None,
     ) -> None:
         for c in certs:
             existing = self._certs.get(c.id)
@@ -64,7 +80,7 @@ class Keyring:
             elif existing is not c:
                 existing.merge(c)
         if priv is not None:
-            self._keys[certmod.key_id(priv.n, priv.e)] = priv
+            self._keys[certmod.private_key_id(priv)] = priv
 
     def remove(self, ids: list[int]) -> None:
         for i in ids:
@@ -81,7 +97,7 @@ class Keyring:
     def get(self, node_id: int) -> certmod.Certificate | None:
         return self._certs.get(node_id)
 
-    def private_key(self, node_id: int) -> rsa.PrivateKey:
+    def private_key(self, node_id: int):
         k = self._keys.get(node_id)
         if k is None:
             raise ERR_KEY_NOT_FOUND
@@ -117,4 +133,4 @@ class Keyring:
             key = read_private_key(r)
             if key is None:
                 return
-            self._keys[certmod.key_id(key.n, key.e)] = key
+            self._keys[certmod.private_key_id(key)] = key

@@ -97,6 +97,18 @@ def _private(key: rsa.PrivateKey):
     ).private_key()
 
 
+def _wrap_to(c: certmod.Certificate, secret: bytes) -> bytes:
+    """Key-wrap ``secret`` to a peer in the peer's own algorithm:
+    RSA-OAEP(SHA-256) for RSA certs, ECIES (ephemeral ECDH + HKDF +
+    AES-GCM) for P-256 certs.  The recipient knows its own key type, so
+    no wire tag is needed."""
+    if c.alg == certmod.ALG_RSA:
+        return _public(c).encrypt(secret, _OAEP)
+    from bftkv_tpu.crypto import ecdsa as _ecdsa
+
+    return _ecdsa.ecies_wrap(secret, c.public_key)
+
+
 class _SessionOut:
     __slots__ = ("sid", "key", "role")
 
@@ -121,10 +133,14 @@ class MessageSecurity:
     #: Hostile peers can spam bootstraps; both caches are LRU-bounded.
     _CACHE_MAX = 8192
 
-    def __init__(self, key: rsa.PrivateKey, certificate: certmod.Certificate):
+    def __init__(self, key, certificate: certmod.Certificate):
+        """``key`` is an RSA or an ECDSA P-256 private key (matching
+        ``certificate``); envelopes to/from this identity use its
+        algorithm for both key unwrap and the bootstrap signature."""
         self.key = key
         self.cert = certificate
-        self._priv = _private(key)
+        self._is_ec = certmod.is_ec(key)
+        self._priv = None if self._is_ec else _private(key)
         self._lock = threading.Lock()
         # peer id -> _SessionOut (how I encrypt *to* that peer)
         self._by_peer: "OrderedDict[int, _SessionOut]" = OrderedDict()
@@ -213,7 +229,7 @@ class MessageSecurity:
             skey = os.urandom(32)
             grants.write(struct.pack(">Q", r.id))
             write_chunk(grants, sid)
-            write_chunk(grants, _public(r).encrypt(skey, _OAEP))
+            write_chunk(grants, _wrap_to(r, skey))
             new_sessions.append(
                 (r.id, _SessionOut(sid, skey, _ROLE_INITIATOR), r)
             )
@@ -224,7 +240,12 @@ class MessageSecurity:
         write_chunk(inner, self.cert.serialize())
         write_chunk(inner, grants.getvalue())
         body = inner.getvalue()
-        sig = rsa.sign(body, self.key)
+        if self._is_ec:
+            from bftkv_tpu.crypto import ecdsa as _ecdsa
+
+            sig = _ecdsa.sign(body, self.key)
+        else:
+            sig = rsa.sign(body, self.key)
         signed = io.BytesIO()
         signed.write(body)
         write_chunk(signed, sig)
@@ -237,9 +258,8 @@ class MessageSecurity:
         out.write(bytes([_TAG_BOOTSTRAP]))
         out.write(struct.pack(">H", len(recipients)))
         for r in recipients:
-            wrapped = _public(r).encrypt(content_key, _OAEP)
             out.write(struct.pack(">Q", r.id))
-            write_chunk(out, wrapped)
+            write_chunk(out, _wrap_to(r, content_key))
         write_chunk(out, gcm_nonce + ct)
 
         # Commit the new outbound sessions only after the envelope is
@@ -346,7 +366,7 @@ class MessageSecurity:
         if wrapped is None or blob is None or len(blob) < 12:
             raise ERR_DECRYPTION_FAILURE
         try:
-            content_key = self._priv.decrypt(wrapped, _OAEP)
+            content_key = self._unwrap(wrapped)
             signed = AESGCM(content_key).decrypt(blob[:12], blob[12:], None)
         except Exception:
             raise ERR_DECRYPTION_FAILURE from None
@@ -368,14 +388,19 @@ class MessageSecurity:
         if not senders:
             raise ERR_INVALID_TRANSPORT_SECURITY_DATA
         sender = senders[0]
-        try:
-            ok = rsa.verify_host(signed[:body_end], sig, sender.public_key)
-        except Exception:
-            ok = False
-        if not ok:
+        if not certmod.verify_detached(signed[:body_end], sig, sender):
             raise ERR_INVALID_SIGNATURE
         self._accept_grant(grant_bytes, sender)
         return plaintext, sender, nonce
+
+    def _unwrap(self, wrapped: bytes) -> bytes:
+        """Unwrap a key blob addressed to this identity (inverse of
+        :func:`_wrap_to` for our own algorithm)."""
+        if self._is_ec:
+            from bftkv_tpu.crypto import ecdsa as _ecdsa
+
+            return _ecdsa.ecies_unwrap(wrapped, self.key)
+        return self._priv.decrypt(wrapped, _OAEP)
 
     def _accept_grant(self, grant_bytes: bytes, sender) -> None:
         """Install the session granted to *me* (if any). Grants are
@@ -393,7 +418,7 @@ class MessageSecurity:
                 wk = read_chunk(gr) or b""
                 if rid != self.cert.id:
                     continue
-                skey = self._priv.decrypt(wk, _OAEP)
+                skey = self._unwrap(wk)
                 with self._lock:
                     # A session id belongs to the pair that first used
                     # it: a Byzantine peer must not be able to overwrite

@@ -11,10 +11,20 @@ coalesces across processes).
 Only *verification* is ever remoted: it consumes public data, so
 co-located replicas sharing one sidecar keeps each replica's secrets in
 its own process (SURVEY §5's Byzantine-boundary discipline).
+
+Trust in the verdicts equals trust in the transport.  Prefer a Unix
+domain socket address (``unix:/path/sock`` — the sidecar creates it
+mode 0600), or pass ``secret=`` for HMAC-authenticated frames over
+TCP: a crashed sidecar's TCP port can be squatted by any local user,
+and an unauthenticated client would accept the impostor's "all valid"
+verdicts.  With a secret configured the client *fails closed*: a
+response with a missing/bad tag is treated as a transport failure and
+the batch is verified locally.
 """
 
 from __future__ import annotations
 
+import hmac
 import socket
 import struct
 import threading
@@ -22,7 +32,13 @@ import time
 
 import numpy as np
 
-from bftkv_tpu.cmd.verify_sidecar import encode_request
+from bftkv_tpu.cmd.verify_sidecar import (
+    TAG_LEN,
+    encode_request,
+    request_tag,
+    response_tag,
+)
+from bftkv_tpu.crypto import cert as certmod
 from bftkv_tpu.crypto import rsa
 from bftkv_tpu.metrics import registry as metrics
 
@@ -43,10 +59,24 @@ class RemoteVerifierDomain:
     #: flush for up to two timeouts, serializing the dispatcher.
     BREAKER_SECONDS = 30.0
 
-    def __init__(self, addr: str, *, timeout: float = 30.0, local=None):
-        host, _, port = addr.rpartition(":")
-        self._addr = (host or "127.0.0.1", int(port))
+    def __init__(
+        self,
+        addr: str,
+        *,
+        timeout: float = 30.0,
+        local=None,
+        secret: bytes | None = None,
+    ):
+        # With the default (host-only) fallback, EC items must also stay
+        # on host: this process deliberately does not own an accelerator.
+        self._ec_host_only = local is None
+        if addr.startswith("unix:"):
+            self._addr: tuple | str = addr[len("unix:"):]
+        else:
+            host, _, port = addr.rpartition(":")
+            self._addr = (host or "127.0.0.1", int(port))
         self._timeout = timeout
+        self._secret = secret
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
         self._skip_until = 0.0
@@ -57,6 +87,11 @@ class RemoteVerifierDomain:
         self.host_threshold = rsa.VerifierDomain.HOST_CROSSOVER
 
     def _connect(self) -> socket.socket:
+        if isinstance(self._addr, str):
+            s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            s.settimeout(self._timeout)
+            s.connect(self._addr)
+            return s
         s = socket.create_connection(self._addr, timeout=self._timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s
@@ -64,16 +99,36 @@ class RemoteVerifierDomain:
     def verify_batch(self, items: list) -> np.ndarray:
         # Hostile public keys (oversized e, absurd n) must fail closed
         # per item like the local path — not blow up the whole frame.
+        # ECDSA P-256 items never ride the (RSA-shaped) sidecar wire:
+        # they go to the local domain's batched EC verifier.
         wire_idx: list[int] = []
         wire_items: list = []
         out_all = np.zeros((len(items),), dtype=bool)
         local_idx: list[int] = []
+        ec_idx: list[int] = []
         for i, (msg, sig, key) in enumerate(items):
-            if 0 < key.e < (1 << 32) and key.n > 0:
+            if certmod.is_ec(key):
+                ec_idx.append(i)
+            elif 0 < key.e < (1 << 32) and key.n > 0:
                 wire_idx.append(i)
                 wire_items.append((msg, sig, key))
             else:
                 local_idx.append(i)
+        if ec_idx:
+            if self._ec_host_only:
+                from bftkv_tpu.crypto import ecdsa as _ecdsa
+
+                for i in ec_idx:
+                    try:
+                        m, s, k = items[i]
+                        out_all[i] = _ecdsa.verify_host(m, s, k)
+                    except Exception:
+                        out_all[i] = False
+            else:
+                out_all[np.asarray(ec_idx)] = np.asarray(
+                    self.local.verify_batch([items[i] for i in ec_idx]),
+                    dtype=bool,
+                )
         for i in local_idx:
             try:
                 msg, sig, key = items[i]
@@ -93,6 +148,8 @@ class RemoteVerifierDomain:
         if time.monotonic() < self._skip_until:
             return None
         body = encode_request(items)
+        if self._secret is not None:
+            body += request_tag(self._secret, body)
         frame = struct.pack(">I", len(body)) + body
         with self._lock:
             for attempt in range(2):
@@ -100,7 +157,7 @@ class RemoteVerifierDomain:
                     if self._sock is None:
                         self._sock = self._connect()
                     self._sock.sendall(frame)
-                    out = self._read_response(len(items))
+                    out = self._read_response(len(items), body)
                     if out is not None:
                         metrics.incr("verify.remote", len(items))
                         return out
@@ -113,15 +170,28 @@ class RemoteVerifierDomain:
             metrics.incr("verify.remote_breaker_open")
         return None
 
-    def _read_response(self, n: int) -> np.ndarray | None:
+    def _read_response(self, n: int, req_body: bytes) -> np.ndarray | None:
         hdr = self._recvall(4)
         (ln,) = struct.unpack(">I", hdr)
-        if ln != n:
-            # Sidecar rejected the frame (or protocol skew): local.
+        expect = n + (TAG_LEN if self._secret is not None else 0)
+        if ln != expect:
+            # Count mismatch: the sidecar rejected the frame, hit an
+            # internal error (zero-length reply), or protocol skew —
+            # all resolve to LOCAL verification.
             if ln:
-                self._recvall(ln)
+                self._recvall(min(ln, 1 << 20))
             return None
         body = self._recvall(ln)
+        if self._secret is not None:
+            # The request body the tag covers excludes our own tag.
+            out, tag = body[:n], body[n:]
+            if not hmac.compare_digest(
+                tag, response_tag(self._secret, req_body[:-TAG_LEN], out)
+            ):
+                # Forged/replayed verdicts (port squatter): fail closed.
+                metrics.incr("verify.remote_bad_mac")
+                raise ConnectionError("sidecar response MAC mismatch")
+            body = out
         return np.frombuffer(body, dtype=np.uint8).astype(bool)
 
     def _recvall(self, n: int) -> bytes:

@@ -476,7 +476,17 @@ class VerifierDomain:
         out = np.zeros((len(items),), dtype=bool)
         device_idx: list[int] = []
         device_items: list[tuple[bytes, bytes, PublicKey]] = []
+        ec_idx: list[int] = []
+        ec_items: list = []
         for i, (message, sig_bytes, key) in enumerate(items):
+            from bftkv_tpu.crypto import cert as certmod
+
+            if certmod.is_ec(key):
+                # ECDSA P-256 identity keys: batched device verify via
+                # ops.ec (two scalar mults per item in one launch).
+                ec_idx.append(i)
+                ec_items.append((message, sig_bytes, key))
+                continue
             # 512-bit floor keeps the PKCS#1 encoding well-defined.
             if (
                 key.e == F4
@@ -491,6 +501,13 @@ class VerifierDomain:
                     out[i] = key.n > 0 and verify_host(message, sig_bytes, key)
                 except Exception:
                     out[i] = False
+        if ec_items:
+            from bftkv_tpu.crypto import ecdsa as _ecdsa
+
+            metrics.incr("verify.ec", len(ec_items))
+            out[np.asarray(ec_idx)] = np.asarray(
+                _ecdsa.verify_batch(ec_items), dtype=bool
+            )
         if device_items and len(device_items) < self.host_threshold:
             metrics.incr("verify.host", len(device_items))
             for j, (message, sig_bytes, key) in zip(device_idx, device_items):

@@ -68,9 +68,11 @@ def parse_entries(data: bytes | None) -> list[tuple[int, bytes]]:
 
 class Signer:
     """Issues detached signatures bound to one identity
-    (reference: crypto_pgp.go:346-371)."""
+    (reference: crypto_pgp.go:346-371).  ``key`` is an RSA or an ECDSA
+    P-256 private key; signatures are issued in its algorithm, like the
+    reference's algorithm-agnostic PGP layer (crypto_pgp.go:346-371)."""
 
-    def __init__(self, key: rsa.PrivateKey, certificate: certmod.Certificate):
+    def __init__(self, key, certificate: certmod.Certificate):
         self.key = key
         self.cert = certificate
 
@@ -89,8 +91,20 @@ class Signer:
         ``issue`` is the one-item form."""
         from bftkv_tpu.ops import dispatch
 
-        d = dispatch.get_signer()
-        if d is not None:
+        if certmod.is_ec(self.key):
+            from bftkv_tpu.crypto import ecdsa as _ecdsa
+
+            # Device batching (ops.ec base-mults for the nonces) only
+            # when a sign dispatcher was installed — i.e. this process
+            # explicitly claimed a chip (--dispatch).  Signing stays
+            # host-side otherwise, exactly like the RSA branch; a
+            # sidecar-mode daemon must never initialize the accelerator
+            # the sidecar owns.
+            if dispatch.get_signer() is not None:
+                sigs = _ecdsa.sign_batch(tbs_list, self.key)
+            else:
+                sigs = [_ecdsa.sign(tbs, self.key) for tbs in tbs_list]
+        elif (d := dispatch.get_signer()) is not None:
             sigs = d.submit([(tbs, self.key) for tbs in tbs_list])
         else:
             sigs = [rsa.sign(tbs, self.key) for tbs in tbs_list]
@@ -262,13 +276,14 @@ class CollectiveSignature:
 def verify_with_certificate(
     tbs: bytes, pkt: SignaturePacket | None, certificate: certmod.Certificate
 ) -> None:
-    """Verify a single-signer packet against a known certificate
-    (reference: crypto/crypto.go:60, used by server.go:207)."""
+    """Verify a single-signer packet against a known certificate, in the
+    certificate's own algorithm (reference: crypto/crypto.go:60, used by
+    server.go:207; algorithm dispatch per crypto_pgp.go:310-405)."""
     if pkt is None or not pkt.data:
         raise ERR_INVALID_SIGNATURE
     for sid, sig in parse_entries(pkt.data):
         if sid == certificate.id:
-            if rsa.verify_host(tbs, sig, certificate.public_key):
+            if certmod.verify_detached(tbs, sig, certificate):
                 return
             raise ERR_INVALID_SIGNATURE
     raise ERR_INVALID_SIGNATURE

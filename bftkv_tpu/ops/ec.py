@@ -273,6 +273,22 @@ def add_batch(X1, Y1, Z1, X2, Y2, Z2):
 # ---------------------------------------------------------------------------
 
 
+def _use_rns_backend() -> bool:
+    """``BFTKV_EC_BACKEND``: "limb" (this module's Montgomery-limb
+    kernel), "rns" (the MXU field core, :mod:`bftkv_tpu.ops.ec_rns`),
+    or "auto" (default): RNS on a TPU backend — where the limb kernel's
+    emulated integer multiplies are the round-3 bottleneck (556
+    mults/s @ 64) — and limb on CPU."""
+    import os
+
+    mode = os.environ.get("BFTKV_EC_BACKEND", "auto")
+    if mode == "rns":
+        return True
+    if mode == "auto":
+        return jax.default_backend() == "tpu"
+    return False
+
+
 def scalar_mult_hosts(points: list, scalars: list[int]) -> list:
     """Batched k·P on device for host affine points / int scalars.
 
@@ -281,6 +297,10 @@ def scalar_mult_hosts(points: list, scalars: list[int]) -> list:
     """
     if not points:
         return []
+    if _use_rns_backend():
+        from bftkv_tpu.ops import ec_rns
+
+        return ec_rns.scalar_mult_hosts(points, scalars)
     d = p256()
     k = len(points)
     padded = max(8, 1 << (k - 1).bit_length())

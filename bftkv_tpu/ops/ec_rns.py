@@ -1,0 +1,364 @@
+"""P-256 scalar multiplication on the RNS/MXU field core.
+
+The limb-based P-256 kernel (:mod:`bftkv_tpu.ops.ec`) pays the same
+tax the limb RSA kernels did: every field multiply is a 16-step digit
+convolution of *emulated* integer multiplies on the VPU (556 scalar
+mults/s at batch 64 — the weakest kernel in the round-3 record).  This
+module applies the RNS playbook that made RSA fast
+(:mod:`bftkv_tpu.ops.rns`) to the P-256 field:
+
+- field elements live as residues over ~54 primes of ~12 bits (two
+  bases + a 2^12 redundant channel), so a field multiply is one
+  channelwise f32 Barrett pass plus two base extensions that run as
+  exact bf16 MXU matmuls — no emulated integer arithmetic anywhere;
+- the modulus is FIXED (the P-256 prime), so all Montgomery/extension
+  constants are compile-time and broadcast — zero per-row key traffic;
+- values are kept in redundant AMM form (< c·p for a tracked
+  coefficient c); adds and subtracts are channelwise and *don't*
+  reduce — only the Montgomery product does (every ``fmul`` output is
+  < (k+2)·p ≈ 30·p).  Subtraction adds a fixed multiple of p to stay
+  positive; the group-law formulas stack at most two subtractions, so
+  a two-level slack policy (2^14·p, then 2^16·p) keeps every value
+  positive and every product far inside the ~64 bits of headroom the
+  bases carry over p (worst pairing ≈ 2^34 ≪ 2^64);
+- "is zero (mod p)" — needed by the unified group law for the
+  identity/doubling lanes — uses the α-consistency trick from RSA
+  verify: v < c·p is a multiple of p iff w_j = v_j·(p⁻¹ mod p_j)
+  agrees across every channel (then v = w_0·p exactly, because
+  |v − w_0·p| < M).  Exact provided c < min channel prime (~3833), so
+  the law only tests *fresh* values: differences of ``fmul`` outputs
+  with the small slack (bound 62·p) and the Z coordinate, which is
+  kept eligible by construction — ``jac_double`` computes
+  Z3 = 2·Y1·Z1 (a mult, not the (Y+Z)²−γ−δ trick), which also keeps
+  the identity's Z an *exact* integer 0 through every operation;
+- scalar mult is fixed 4-bit windows over 64 steps: 4 doublings + a
+  one-hot table select + one unified add per window — constant-time,
+  uniform across the batch (reference hot loop this accelerates:
+  crypto/threshold/ecdsa/ecdsa.go:31-59, plus identity-cert ECDSA).
+
+Selection: ``ops.ec.scalar_mult_hosts`` routes here per
+``BFTKV_EC_BACKEND`` (limb | rns | auto); ``crypto/ec.py`` remains the
+host correctness oracle either way.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from bftkv_tpu.crypto.ec import P256
+from bftkv_tpu.ops import limb, rns
+
+__all__ = ["scalar_mult_hosts", "scalar_base_mult_hosts"]
+
+_DIGITS = 16  # 256 bits / 16-bit digits
+_WINDOW = 4
+_NWIN = 256 // _WINDOW
+
+# fsub slack multiples of p.  SMALL: for differences of fmul outputs
+# (< 30p) that must stay is_zero-eligible.  L1: subtrahend is an fmul
+# output or a short add-chain of them (< 2^12·p).  L2: subtrahend is
+# itself an L1 fsub output (< 2^14.1·p).
+_S_SMALL = 32
+_S_L1 = 1 << 14
+_S_L2 = 1 << 16
+
+
+class _P256RNS:
+    """Fixed-modulus RNS field context + device constants."""
+
+    def __init__(self):
+        ctx = rns.context(_DIGITS, 256)
+        self.ctx = ctx
+        self.cn = rns._Consts(ctx)
+        self.k = ctx.k
+        p = P256.p
+        key = ctx.key_rows(p)
+        self.key = tuple(
+            jnp.asarray(
+                np.asarray(a)[None]
+                if np.ndim(a)
+                else np.full((1, 1), a, dtype=np.float32)
+            )
+            for a in key
+        )
+        f32 = lambda xs: np.asarray(xs, dtype=np.float32)
+
+        def const_of(v: int):
+            """Residues of integer v as a broadcastable RNS triplet."""
+            return (
+                jnp.asarray(f32([v % q for q in ctx.pb])[None]),
+                jnp.asarray(f32([v % q for q in ctx.pq])[None]),
+                jnp.asarray(np.full((1, 1), v % rns.PR, dtype=np.float32)),
+            )
+
+        self.sp = {
+            _S_SMALL: const_of(_S_SMALL * p),
+            _S_L1: const_of(_S_L1 * p),
+            _S_L2: const_of(_S_L2 * p),
+        }
+        # p⁻¹ mod p_j over base B — the is_zero α extractor.
+        self.pinv_b = jnp.asarray(
+            f32([pow(p % q, -1, q) for q in ctx.pb])[None]
+        )
+        r_int = ctx.M % p  # the Montgomery "one"
+        self.one_m = const_of(r_int)
+        self.zero = const_of(0)
+
+    # -- field ops (triplets (xb (T,k), xq (T,k), xr (T,1))) -----------
+
+    def fmul(self, a, b):
+        return rns._mont_mul(self.cn, a, b, self.key)
+
+    def fadd(self, a, b):
+        cn = self.cn
+        return (
+            rns._addmod(a[0], b[0], cn.pb),
+            rns._addmod(a[1], b[1], cn.pq),
+            rns._mod_r(a[2] + b[2]),
+        )
+
+    def fsub(self, a, b, s: int = _S_L1):
+        """a − b + s·p (s·p ≡ 0 mod p keeps the residue class; s must
+        exceed b's bound coefficient so the value stays positive)."""
+        sp = self.sp[s]
+        cn = self.cn
+        return (
+            rns._addmod(rns._submod(a[0], b[0], cn.pb), sp[0], cn.pb),
+            rns._addmod(rns._submod(a[1], b[1], cn.pq), sp[1], cn.pq),
+            rns._mod_r(a[2] - b[2] + sp[2] + rns._PRF),
+        )
+
+    def fdbl(self, a):
+        return self.fadd(a, a)
+
+    def is_zero(self, v):
+        """(T,) bool: v ≡ 0 (mod p), exact for v < (min prime)·p."""
+        cn = self.cn
+        w = rns._mulmod(v[0], self.pinv_b, cn.ib, cn.pb)
+        alpha = w[:, :1]
+        return jnp.all(w == alpha, axis=1) & (
+            alpha[:, 0] <= np.float32(2 * _S_SMALL)
+        )
+
+    def select(self, cond, a, b):
+        """Per-lane triplet select; cond is (T,)."""
+        c = cond[:, None]
+        return tuple(jnp.where(c, x, y) for x, y in zip(a, b))
+
+    # -- group law (Jacobian, unified / branch-free) -------------------
+
+    def jac_double(self, X1, Y1, Z1):
+        """dbl-2001-b shape for a = −3, except Z3 = 2·Y1·Z1: a mult
+        keeps Z3 < 60p (is_zero-eligible) and maps the identity's
+        exact-0 Z to exact 0 (0 is absorbing through fmul/fadd)."""
+        delta = self.fmul(Z1, Z1)
+        gamma = self.fmul(Y1, Y1)
+        beta = self.fmul(X1, gamma)
+        t0 = self.fsub(X1, delta, _S_L1)
+        t1 = self.fadd(X1, delta)
+        alpha = self.fmul(t0, self.fadd(self.fdbl(t1), t1))
+        beta4 = self.fdbl(self.fdbl(beta))  # < 120p
+        X3 = self.fsub(self.fmul(alpha, alpha), self.fdbl(beta4), _S_L1)
+        Z3 = self.fdbl(self.fmul(Y1, Z1))
+        g2 = self.fmul(gamma, gamma)
+        Y3 = self.fsub(
+            self.fmul(alpha, self.fsub(beta4, X3, _S_L2)),
+            self.fdbl(self.fdbl(self.fdbl(g2))),
+            _S_L1,
+        )
+        return X3, Y3, Z3
+
+    def jac_add(self, P1, P2):
+        X1, Y1, Z1 = P1
+        X2, Y2, Z2 = P2
+        Z1Z1 = self.fmul(Z1, Z1)
+        Z2Z2 = self.fmul(Z2, Z2)
+        U1 = self.fmul(X1, Z2Z2)
+        U2 = self.fmul(X2, Z1Z1)
+        S1 = self.fmul(self.fmul(Y1, Z2), Z2Z2)
+        S2 = self.fmul(self.fmul(Y2, Z1), Z1Z1)
+        # H/R: differences of fmul outputs with the SMALL slack — the
+        # only values (besides Z) the is_zero test ever sees.
+        H = self.fsub(U2, U1, _S_SMALL)
+        R = self.fsub(S2, S1, _S_SMALL)
+        H2 = self.fmul(H, H)
+        H3 = self.fmul(H2, H)
+        U1H2 = self.fmul(U1, H2)
+        X3 = self.fsub(
+            self.fsub(self.fmul(R, R), H3, _S_L1), self.fdbl(U1H2), _S_L1
+        )
+        Y3 = self.fsub(
+            self.fmul(R, self.fsub(U1H2, X3, _S_L2)),
+            self.fmul(S1, H3),
+            _S_L1,
+        )
+        Z3 = self.fmul(self.fmul(Z1, Z2), H)
+
+        dX, dY, dZ = self.jac_double(X1, Y1, Z1)
+
+        inf1 = self.is_zero(Z1)
+        inf2 = self.is_zero(Z2)
+        same_x = self.is_zero(H) & ~inf1 & ~inf2
+        same_y = self.is_zero(R)
+        is_dbl = same_x & same_y
+        to_inf = same_x & ~same_y  # P + (−P) = O
+
+        X = self.select(is_dbl, dX, X3)
+        Y = self.select(is_dbl, dY, Y3)
+        Z = self.select(is_dbl, dZ, Z3)
+        Z = self.select(to_inf, tuple(jnp.zeros_like(c) for c in Z), Z)
+        X = self.select(inf1, X2, self.select(inf2, X1, X))
+        Y = self.select(inf1, Y2, self.select(inf2, Y1, Y))
+        Z = self.select(inf1, Z2, self.select(inf2, Z1, Z))
+        return X, Y, Z
+
+    # -- host codecs ---------------------------------------------------
+
+    def encode_points(self, pts: list):
+        """Affine host points (None = identity) → Montgomery RNS batch."""
+        p = P256.p
+        M = self.ctx.M
+        one = M % p
+        xs, ys, zs = [], [], []
+        for pt in pts:
+            if pt is None:
+                xs.append(one)  # placeholder; Z = 0 marks identity
+                ys.append(one)
+                zs.append(0)
+            else:
+                xs.append((pt[0] * M) % p)
+                ys.append((pt[1] * M) % p)
+                zs.append(one)
+        return tuple(self._ints_to_res(v) for v in (xs, ys, zs))
+
+    def _ints_to_res(self, vals: list[int]):
+        ctx = self.ctx
+        t = len(vals)
+        out_b = np.empty((t, self.k), dtype=np.float32)
+        out_q = np.empty((t, self.k), dtype=np.float32)
+        out_r = np.empty((t, 1), dtype=np.float32)
+        for i, v in enumerate(vals):
+            out_b[i] = [v % q for q in ctx.pb]
+            out_q[i] = [v % q for q in ctx.pq]
+            out_r[i, 0] = v % rns.PR
+        return (jnp.asarray(out_b), jnp.asarray(out_q), jnp.asarray(out_r))
+
+    def decode_points(self, X, Y, Z) -> list:
+        """Jacobian Montgomery RNS batch → affine host points.  The
+        final Z inversion is host-side ``pow`` (one ~µs op per point —
+        not worth a device Fermat chain)."""
+        ctx = self.ctx
+        p = P256.p
+        ones = tuple(jnp.ones_like(c) for c in X)
+        outs = []
+        for comp in (X, Y, Z):
+            plain = self.fmul(comp, ones)  # strip the Montgomery factor
+            sigma = rns._mulmod(
+                plain[0], self.cn.invMi_b, self.cn.ib, self.cn.pb
+            )
+            vals = rns._sigma_to_ints(ctx, np.asarray(sigma))
+            outs.append([v % p for v in vals])
+        xs, ys, zs = outs
+        pts = []
+        for x, y, z in zip(xs, ys, zs):
+            if z == 0:
+                pts.append(None)
+                continue
+            zi = pow(z, -1, p)
+            zi2 = zi * zi % p
+            pts.append((x * zi2 % p, y * zi2 * zi % p))
+        return pts
+
+
+@functools.lru_cache(maxsize=1)
+def _engine() -> _P256RNS:
+    return _P256RNS()
+
+
+def _bcast(c, like):
+    return tuple(
+        jnp.broadcast_to(a, (like.shape[0],) + a.shape[1:]) for a in c
+    )
+
+
+@functools.lru_cache(maxsize=1)
+def _scalar_mult_fn():
+    eng = _engine()
+
+    def run(Xb, Xq, Xr, Yb, Yq, Yr, Zb, Zq, Zr, nibbles_t):
+        P = ((Xb, Xq, Xr), (Yb, Yq, Yr), (Zb, Zq, Zr))
+        one_m = _bcast(eng.one_m, Xb)
+        ident = (one_m, one_m, _bcast(eng.zero, Xb))
+        # Window table t[j] = j·P (t[0] = identity), 15 unified adds.
+        tab = [ident, P]
+        for _ in range(14):
+            tab.append(eng.jac_add(tab[-1], P))
+        k = eng.k
+        # Concatenate per coordinate/component for the one-hot select.
+        cat = [
+            [jnp.concatenate([t[i][j] for t in tab], axis=1)
+             for j in range(3)]
+            for i in range(3)
+        ]
+
+        def sel(nib, i):
+            comps = []
+            for j, width in ((0, k), (1, k), (2, 1)):
+                tcat = cat[i][j]
+                acc = jnp.zeros_like(tcat[:, :width])
+                for w in range(16):
+                    m = (nib == np.float32(w)).astype(jnp.float32)
+                    acc = acc + m * tcat[:, w * width : (w + 1) * width]
+                comps.append(acc)
+            return tuple(comps)
+
+        def body(acc, nib):
+            for _ in range(_WINDOW):
+                acc = eng.jac_double(*acc)
+            nibc = nib[:, None]
+            q = (sel(nibc, 0), sel(nibc, 1), sel(nibc, 2))
+            return eng.jac_add(acc, q), None
+
+        acc, _ = lax.scan(body, ident, nibbles_t)
+        return acc
+
+    return jax.jit(run)
+
+
+def _nibbles(scalars: list[int]) -> np.ndarray:
+    """(NWIN, T) f32 window values, most-significant first."""
+    ks = [s % P256.n for s in scalars]
+    ed = limb.ints_to_limbs(ks, _DIGITS)  # (T, 16) 16-bit digits
+    nib = np.empty((len(ks), _NWIN), dtype=np.float32)
+    nib[:, 0::4] = ed & 0xF
+    nib[:, 1::4] = (ed >> 4) & 0xF
+    nib[:, 2::4] = (ed >> 8) & 0xF
+    nib[:, 3::4] = (ed >> 12) & 0xF
+    nib = nib[:, ::-1]
+    return np.ascontiguousarray(nib.T)
+
+
+def scalar_mult_hosts(points: list, scalars: list[int]) -> list:
+    """Batched k·P on the RNS field core; same contract as
+    :func:`bftkv_tpu.ops.ec.scalar_mult_hosts` (power-of-two padding,
+    floor 8)."""
+    if not points:
+        return []
+    eng = _engine()
+    t = len(points)
+    padded = max(8, 1 << (t - 1).bit_length())
+    points = list(points) + [None] * (padded - t)
+    scalars = list(scalars) + [0] * (padded - t)
+    X, Y, Z = eng.encode_points(points)
+    nib = _nibbles(scalars)
+    out = _scalar_mult_fn()(*X, *Y, *Z, jnp.asarray(nib))
+    return eng.decode_points(*out)[:t]
+
+
+def scalar_base_mult_hosts(scalars: list[int]) -> list:
+    return scalar_mult_hosts([(P256.gx, P256.gy)] * len(scalars), scalars)

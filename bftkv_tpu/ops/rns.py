@@ -30,6 +30,7 @@ removes both the convolution and the integer arithmetic:
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -585,14 +586,26 @@ def power_mod_rns(
     nibbles[:, 2::4] = (ed >> 8) & 0xF
     nibbles[:, 3::4] = (ed >> 12) & 0xF
     nibbles = nibbles[:, ::-1]  # most-significant nibble first
-    sigma = np.asarray(
-        _jitted_pow(digits, n_bits)(
-            digits_to_halves_u8(base_digits),
-            np.ascontiguousarray(nibbles.T),
-            np.asarray(idxs, dtype=np.int32),
-            ukey,
-        )
-    )[:t]
+    pow_args = (
+        digits_to_halves_u8(base_digits),
+        np.ascontiguousarray(nibbles.T),
+        np.asarray(idxs, dtype=np.int32),
+        ukey,
+    )
+    if _use_pallas("BFTKV_RNS_POW_BACKEND"):
+        from bftkv_tpu.ops import pallas_rns
+
+        sigma = np.asarray(
+            pallas_rns.pow_pallas(
+                *pow_args, digits=digits, n_bits=n_bits
+            )
+        )[:t]
+    elif _shardable(padded):
+        sigma = np.asarray(
+            _jitted_pow_sharded(digits, n_bits)(*pow_args)
+        )[:t]
+    else:
+        sigma = np.asarray(_jitted_pow(digits, n_bits)(*pow_args))[:t]
     vals = _sigma_to_ints(ctx, sigma)
     return [v % m for v, m in zip(vals, mods[:t])]
 
@@ -629,6 +642,108 @@ def verify_e65537_rns(sig_digits, em_digits, key_rows) -> jnp.ndarray:
     return _jitted_verify()(sig_h, em_h, key_rows)
 
 
+def _use_pallas(env: str) -> bool:
+    """Backend choice for the fused VMEM-resident Pallas chains
+    (:mod:`bftkv_tpu.ops.pallas_rns`): "auto" (default) uses them on a
+    single real TPU chip, where they eliminate the inter-matmul HBM
+    round trips; interpret mode on CPU would be far slower than the XLA
+    kernels, and on a multi-chip pool the sharded XLA path spreads the
+    batch over every device (see :func:`_mesh`).  "pallas"/"xla"
+    force."""
+    mode = os.environ.get(env, "auto")
+    if mode == "pallas":
+        return True
+    if mode == "auto":
+        return jax.default_backend() == "tpu" and len(jax.devices()) == 1
+    return False
+
+
+@functools.lru_cache(maxsize=1)
+def _mesh():
+    """1-D device mesh over every local device, or None when sharding
+    is pointless (single device) or disabled (``BFTKV_SHARD=off``).
+
+    This is the production counterpart of the driver's
+    ``dryrun_multichip`` demo: verify/sign flushes are data-parallel
+    over the batch axis, so the dispatcher's launches shard across the
+    replica's whole accelerator pool via ``shard_map`` — collectives
+    stay strictly inside one replica's trust domain (SURVEY §5)."""
+    if os.environ.get("BFTKV_SHARD", "auto") == "off":
+        return None
+    devs = jax.devices()
+    if len(devs) < 2:
+        return None
+    return jax.sharding.Mesh(np.array(devs), ("batch",))
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map as _sm
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map as _sm
+    return _sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+@functools.lru_cache(maxsize=1)
+def _jitted_verify_gather_sharded():
+    """The gather-verify kernel sharded over the batch axis of the
+    local device mesh; key rows replicate (they are small and shared)."""
+    from jax.sharding import PartitionSpec as P
+
+    cn = _Consts(context())
+    mesh = _mesh()
+
+    def body(sig_halves_u8, em_halves_u8, idx, ukey):
+        key = tuple(u[idx] for u in ukey)
+        return _verify_kernel(
+            cn,
+            sig_halves_u8.astype(jnp.float32),
+            em_halves_u8.astype(jnp.float32),
+            key,
+        )
+
+    b = P("batch")
+    return jax.jit(
+        _shard_map(
+            body, mesh,
+            in_specs=(b, b, b, (P(),) * 6),
+            out_specs=b,
+        )
+    )
+
+
+@functools.lru_cache(maxsize=4)
+def _jitted_pow_sharded(digits: int, n_bits: int):
+    from jax.sharding import PartitionSpec as P
+
+    cn = _Consts(context(digits, n_bits))
+    mesh = _mesh()
+
+    def body(base_halves_u8, exp_nibbles_t_u8, idx, ukey):
+        key = tuple(u[idx] for u in ukey)
+        return _pow_kernel(
+            cn,
+            base_halves_u8.astype(jnp.float32),
+            exp_nibbles_t_u8.astype(jnp.float32),
+            key,
+        )
+
+    b = P("batch")
+    return jax.jit(
+        _shard_map(
+            body, mesh,
+            # exponent nibbles ride (W, T): batch is axis 1 there.
+            in_specs=(b, P(None, "batch"), b, (P(),) * 6),
+            out_specs=b,
+        )
+    )
+
+
+def _shardable(batch: int) -> bool:
+    mesh = _mesh()
+    return mesh is not None and batch % mesh.devices.size == 0
+
+
 def verify_e65537_rns_indexed(
     sig_digits, em_digits, key_idx, unique_rows
 ) -> jnp.ndarray:
@@ -638,6 +753,12 @@ def verify_e65537_rns_indexed(
     sig_h = digits_to_halves_u8(np.asarray(sig_digits))
     em_h = digits_to_halves_u8(np.asarray(em_digits))
     idx = np.asarray(key_idx, dtype=np.int32)
+    if _use_pallas("BFTKV_RNS_VERIFY_BACKEND"):
+        from bftkv_tpu.ops import pallas_rns
+
+        return pallas_rns.verify_pallas(sig_h, em_h, idx, unique_rows)
+    if _shardable(sig_h.shape[0]):
+        return _jitted_verify_gather_sharded()(sig_h, em_h, idx, unique_rows)
     return _jitted_verify_gather()(sig_h, em_h, idx, unique_rows)
 
 

@@ -53,7 +53,7 @@ class Identity:
     """One principal: private key + its certificate."""
 
     name: str
-    key: rsa.PrivateKey
+    key: object  # rsa.PrivateKey | ecdsa.ECPrivateKey
     cert: certmod.Certificate
 
     @property
@@ -62,12 +62,27 @@ class Identity:
 
 
 def new_identity(
-    name: str, address: str = "", uid: str = "", bits: int = 2048
+    name: str,
+    address: str = "",
+    uid: str = "",
+    bits: int = 2048,
+    alg: str = certmod.ALG_RSA,
 ) -> Identity:
-    key = rsa.generate(bits)
-    cert = certmod.Certificate(
-        n=key.n, e=key.e, name=name, address=address, uid=uid or name
-    )
+    """``alg``: "rsa" (default) or "p256" — ECDSA P-256 identity keys
+    (BASELINE config 4; reference parity: the PGP layer accepts any key
+    algorithm, crypto_pgp.go:310-405)."""
+    if alg == certmod.ALG_P256:
+        from bftkv_tpu.crypto import ecdsa as _ecdsa
+
+        key = _ecdsa.generate()
+        cert = certmod.make_ec_certificate(
+            key.public, name=name, address=address, uid=uid or name
+        )
+    else:
+        key = rsa.generate(bits)
+        cert = certmod.Certificate(
+            n=key.n, e=key.e, name=name, address=address, uid=uid or name
+        )
     # Self-signature, as gpg does on generation.
     certmod.sign_certificate(cert, key)
     return Identity(name=name, key=key, cert=cert)
@@ -144,13 +159,24 @@ def build_universe(
     bits: int = 2048,
     unsigned_users: int = 0,
     server_trust_rw: bool = False,
+    alg: str = certmod.ALG_RSA,
 ) -> Universe:
     """The canonical test topology (reference: scripts/setup.sh:17-48).
 
     ``unsigned_users``: how many trailing users get *no* server
     counter-signatures — they carry no quorum certificate, the TOFU /
     registration test subject (reference: u04 / test1).
+
+    ``alg``: identity-key algorithm for every principal — "rsa",
+    "p256", or "mixed" (alternating, exercising algorithm agility in
+    one cluster the way the reference's PGP layer would accept mixed
+    keyrings).
     """
+
+    def alg_for(i: int) -> str:
+        if alg == "mixed":
+            return certmod.ALG_P256 if i % 2 else certmod.ALG_RSA
+        return alg
 
     def addr(name: str, port: int) -> str:
         if scheme == "loop":
@@ -163,6 +189,7 @@ def build_universe(
             address=addr(f"a{i + 1:02d}", base_port + i),
             uid=f"a{i + 1:02d}@server",
             bits=bits,
+            alg=alg_for(i),
         )
         for i in range(n_servers)
     ]
@@ -174,6 +201,7 @@ def build_universe(
             address=addr(f"rw{i + 1:02d}", rw_base_port + i),
             uid=f"rw{i + 1:02d}@storage",
             bits=bits,
+            alg=alg_for(i),
         )
         for i in range(n_rw)
     ]
@@ -184,7 +212,9 @@ def build_universe(
     users = []
     for i in range(n_users):
         name = f"u{i + 1:02d}"
-        u = new_identity(name, uid=f"{name}@example.com", bits=bits)
+        u = new_identity(
+            name, uid=f"{name}@example.com", bits=bits, alg=alg_for(i)
+        )
         # The user's own trust edges are added per-view by
         # :meth:`Universe.view_of`, never onto the shared certs.
         if i < n_users - unsigned_users:

@@ -56,6 +56,7 @@ def start_cluster(
     client_cls=Client,
     transport_cls=TrLoopback,
     transport: str = "loop",
+    alg: str = "rsa",
 ) -> Cluster:
     """``transport="loop"`` wires the in-process loopback net;
     ``transport="http"`` starts every server on a real localhost HTTP
@@ -71,14 +72,14 @@ def start_cluster(
         uni = topology.build_universe(
             n_servers, n_users, n_rw, scheme="http", bits=bits,
             base_port=base, rw_base_port=base + 50,
-            unsigned_users=unsigned_users,
+            unsigned_users=unsigned_users, alg=alg,
         )
         net = None
         make_tr = lambda crypt: http_cls(crypt)
     else:
         uni = topology.build_universe(
             n_servers, n_users, n_rw, scheme="loop", bits=bits,
-            unsigned_users=unsigned_users,
+            unsigned_users=unsigned_users, alg=alg,
         )
         net = LoopbackNet()
         make_tr = lambda crypt: transport_cls(crypt, net)

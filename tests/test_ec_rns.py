@@ -1,0 +1,69 @@
+"""RNS P-256 field core (ops/ec_rns) vs the host Jacobian oracle.
+
+Property tests over random scalars/points, identity/doubling edge
+lanes, and the ECDSA verify equation — the VERDICT r3 "rebuild the
+P-256 kernel with the RNS playbook" gate.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+import pytest
+
+pytest.importorskip("jax")
+
+from bftkv_tpu.crypto.ec import P256  # noqa: E402
+from bftkv_tpu.ops import ec_rns  # noqa: E402
+
+
+def test_scalar_mult_matches_host_oracle():
+    pts, ks, want = [], [], []
+    for i in range(8):
+        d = 1 + secrets.randbelow(P256.n - 1)
+        pt = P256.scalar_base_mult(d)
+        k = secrets.randbelow(P256.n)
+        pts.append(pt)
+        ks.append(k)
+        want.append(P256.scalar_mult(pt, k))
+    got = ec_rns.scalar_mult_hosts(pts, ks)
+    assert got == want
+
+
+def test_identity_and_edge_scalars():
+    g = (P256.gx, P256.gy)
+    pts = [None, g, g, g, g]
+    ks = [5, 0, 1, P256.n, P256.n - 1]
+    got = ec_rns.scalar_mult_hosts(pts, ks)
+    assert got[0] is None  # k·O = O
+    assert got[1] is None  # 0·G = O
+    assert got[2] == g  # 1·G = G
+    assert got[3] is None  # n·G = O
+    assert got[4] == P256.scalar_mult(g, P256.n - 1)
+
+
+def test_small_scalars_exercise_doubling_lanes():
+    # 2·G hits the H≡0 doubling lane inside the window adds.
+    g = (P256.gx, P256.gy)
+    ks = list(range(1, 9))
+    got = ec_rns.scalar_base_mult_hosts(ks)
+    for k, pt in zip(ks, got):
+        assert pt == P256.scalar_mult(g, k)
+
+
+def test_ecdsa_equation_on_rns_backend(monkeypatch):
+    # Full ECDSA verify through ops.ec with the RNS backend forced:
+    # u1·G + u2·Q must reconstruct R for genuine signatures only.
+    monkeypatch.setenv("BFTKV_EC_BACKEND", "rns")
+    monkeypatch.setenv("BFTKV_EC_VERIFY_THRESHOLD", "0")
+    monkeypatch.setenv("BFTKV_EC_SIGN_THRESHOLD", "0")
+    from bftkv_tpu.crypto import ecdsa
+
+    key = ecdsa.generate()
+    msgs = [b"rns-%d" % i for i in range(4)]
+    sigs = ecdsa.sign_batch(msgs, key)
+    for m, s in zip(msgs, sigs):
+        assert ecdsa.verify_host(m, s, key.public)
+    items = [(m, s, key.public) for m, s in zip(msgs, sigs)]
+    items[1] = (msgs[1], sigs[2], key.public)
+    assert ecdsa.verify_batch(items) == [True, False, True, True]

@@ -1,0 +1,101 @@
+"""Multi-device sharding of the production verify/sign kernels.
+
+The conftest forces an 8-virtual-device CPU mesh; these tests assert
+the dispatcher-facing RNS entry points (a) actually take the sharded
+path on a multi-device pool, and (b) return bit-identical results to
+the single-device kernels — the VERDICT r3 "make multi-device real"
+gate.  Collectives stay inside one replica's trust domain (SURVEY §5).
+"""
+
+from __future__ import annotations
+
+import secrets
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from bftkv_tpu.crypto import rsa  # noqa: E402
+from bftkv_tpu.ops import limb, rns  # noqa: E402
+
+
+def test_mesh_exists():
+    # conftest's 8-device CPU mesh is what the whole module rides on.
+    assert len(jax.devices()) >= 8
+    assert rns._mesh() is not None
+    assert rns._shardable(64)
+    assert not rns._shardable(7)  # indivisible batches stay single-dev
+
+
+def test_sharded_verify_matches_single_device():
+    key1, key2 = rsa.generate(2048), rsa.generate(2048)
+    ctx = rns.context()
+    msgs = [b"ms-%d" % i for i in range(16)]
+    keys = [key1 if i % 2 else key2 for i in range(16)]
+    sigs = [int.from_bytes(rsa.sign(m, k), "big") for m, k in zip(msgs, keys)]
+    ems = [rsa.emsa_pkcs1v15_sha256(m, k.size_bytes) for m, k in zip(msgs, keys)]
+    sigs[3] ^= 1 << 9
+    sigs[11] ^= 1 << 30
+    sig_d = np.stack([limb.int_to_limbs(s, 128) for s in sigs])
+    em_d = np.stack([limb.int_to_limbs(e, 128) for e in ems])
+    idx = np.array([i % 2 for i in range(16)], dtype=np.int32)
+    ukey = tuple(
+        jnp.asarray(a)
+        for a in rns.stack_key_rows(
+            [ctx.key_rows(key2.n), ctx.key_rows(key1.n)]
+        )
+    )
+    sig_h = rns.digits_to_halves_u8(sig_d)
+    em_h = rns.digits_to_halves_u8(em_d)
+
+    sharded = np.asarray(
+        rns._jitted_verify_gather_sharded()(sig_h, em_h, idx, ukey)
+    )
+    single = np.asarray(rns._jitted_verify_gather()(sig_h, em_h, idx, ukey))
+    want = [i not in (3, 11) for i in range(16)]
+    assert sharded.tolist() == want
+    assert sharded.tolist() == single.tolist()
+
+    # The public entry point routes through the sharded path here.
+    assert rns._shardable(16)
+    public = np.asarray(
+        rns.verify_e65537_rns_indexed(sig_d, em_d, idx, ukey)
+    )
+    assert public.tolist() == want
+
+
+def test_sharded_pow_matches_single_device_and_host():
+    ctx = rns.context(32, 512)
+    mods, bases, exps = [], [], []
+    while len(mods) < 3:
+        m = secrets.randbits(500) | 1
+        if ctx.key_rows(m) is not None:
+            mods.append(m)
+            bases.append(secrets.randbits(490))
+            exps.append(secrets.randbits(470))
+    # power_mod_rns pads to 64 — divisible by the 8-device mesh, so the
+    # public sign path auto-shards; parity against host pow is the gate.
+    got = rns.power_mod_rns(bases, exps, mods, n_bits=512)
+    assert got == [pow(b, e, m) for b, e, m in zip(bases, exps, mods)]
+
+
+def test_dispatcher_flush_on_mesh():
+    # End-to-end: a dispatcher flush large enough to shard returns the
+    # right verdicts through the installed-sidecar call path.
+    from bftkv_tpu.ops import dispatch
+
+    key = rsa.generate(2048)
+    items = []
+    for i in range(16):
+        m = b"df-%d" % i
+        s = rsa.sign(m, key)
+        if i == 7:
+            s = bytes([s[0] ^ 1]) + s[1:]
+        items.append((m, s, key.public))
+    d = dispatch.VerifyDispatcher(
+        verifier=rsa.VerifierDomain(host_threshold=0)
+    )
+    got = np.asarray(d.verify(items))
+    assert got.tolist() == [i != 7 for i in range(16)]
