@@ -52,7 +52,10 @@ def main(argv: list[str] | None = None) -> int:
     os.makedirs(args.out, exist_ok=True)
     for ident in uni.all:
         home = os.path.join(args.out, ident.name)
-        topology.save_home(home, ident, uni.view_of(ident))
+        topology.save_home(
+            home, ident, uni.view_of(ident),
+            local_trust=uni.local_trust_of(ident),
+        )
         print(f"{ident.name}: {home} ({ident.cert.address or 'client'})")
     return 0
 

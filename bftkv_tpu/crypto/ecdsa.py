@@ -101,16 +101,18 @@ def _bits2int(b: bytes, n: int) -> int:
     return v >> excess if excess > 0 else v
 
 
-def _rfc6979_k(e: int, d: int, n: int) -> int:
-    """Deterministic nonce per RFC 6979 §3.2 (SHA-256)."""
+def _rfc6979_k(e: int, d: int, n: int, extra: bytes = b"") -> int:
+    """Nonce per RFC 6979 §3.2 (SHA-256); ``extra`` is the §3.6
+    additional input k' — used to HEDGE device-batched signing (see
+    :func:`sign_batch`)."""
     qlen = (n.bit_length() + 7) // 8
     x = d.to_bytes(qlen, "big")
     h1 = (e % n).to_bytes(qlen, "big")
     K = b"\x00" * 32
     V = b"\x01" * 32
-    K = hmac.new(K, V + b"\x00" + x + h1, hashlib.sha256).digest()
+    K = hmac.new(K, V + b"\x00" + x + h1 + extra, hashlib.sha256).digest()
     V = hmac.new(K, V, hashlib.sha256).digest()
-    K = hmac.new(K, V + b"\x01" + x + h1, hashlib.sha256).digest()
+    K = hmac.new(K, V + b"\x01" + x + h1 + extra, hashlib.sha256).digest()
     V = hmac.new(K, V, hashlib.sha256).digest()
     while True:
         t = b""
@@ -158,7 +160,18 @@ def sign(message: bytes, key: ECPrivateKey) -> bytes:
 
 def sign_batch(messages: list[bytes], key: ECPrivateKey) -> list[bytes]:
     """All nonce base-mults in ONE device launch (ops.ec fixed-window
-    kernel); per-item scalar arithmetic is trivial host work."""
+    kernel); per-item scalar arithmetic is trivial host work.
+
+    Device-batch fault hardening: purely deterministic nonces + a
+    faulted device R enable differential key recovery (two signatures
+    of one message with the same k but different r solve for d — the
+    EC analog of Boneh–DeMillo–Lipton, which the RSA sign paths gate
+    against).  Two countermeasures, both cheap: the nonce is HEDGED
+    with per-batch randomness (RFC 6979 §3.6 additional input), so a
+    wrong-R signature can never be paired with a same-k correct one;
+    and one random item per batch is verified on host, so a
+    systematically faulting kernel cannot stay hidden across batches.
+    """
     if not messages:
         return []
     n = key.curve.n
@@ -167,8 +180,9 @@ def sign_batch(messages: list[bytes], key: ECPrivateKey) -> list[bytes]:
     )
     if len(messages) < threshold:
         return [sign(m, key) for m in messages]
+    hedge = os.urandom(32)
     es = [_msg_scalar(m, n) for m in messages]
-    ks = [_rfc6979_k(e, key.d, n) for e in es]
+    ks = [_rfc6979_k(e, key.d, n, extra=hedge) for e in es]
     from bftkv_tpu.ops import ec as ec_ops
 
     Rs = ec_ops.scalar_base_mult_hosts(ks)
@@ -178,6 +192,15 @@ def sign_batch(messages: list[bytes], key: ECPrivateKey) -> list[bytes]:
         if sig is None:  # r/s ≡ 0 (~2^-256); re-sign THIS message
             sig = sign(msg, key)  # pragma: no cover
         out.append(sig)
+    spot = pysecrets.randbelow(len(out))
+    if not verify_host(messages[spot], out[spot], key.public):
+        # A hedged faulted signature cannot leak the key, but a faulty
+        # kernel means the whole batch is likely garbage (liveness):
+        # fall back to host for everything, loudly.  # pragma: no cover
+        from bftkv_tpu.metrics import registry as _metrics
+
+        _metrics.incr("ec.sign_fault")
+        return [sign(m, key) for m in messages]
     return out
 
 
@@ -225,8 +248,9 @@ def verify_batch(items: list[tuple[bytes, bytes, ECPublicKey]]) -> list[bool]:
         return out
     n = ec.P256.n
     g = (ec.P256.gx, ec.P256.gy)
-    pts, scalars, spans = [], [], []
+    pts, scalars = [], []
     meta: list[tuple[int, int] | None] = []
+    valid = 0
     for message, sig, key in items:
         rs = _split_sig(sig, n) if isinstance(sig, bytes) else None
         if (
@@ -239,10 +263,10 @@ def verify_batch(items: list[tuple[bytes, bytes, ECPublicKey]]) -> list[bool]:
         r, s = rs
         e = _msg_scalar(message, n)
         w = pow(s, -1, n)
-        spans.append(len(pts))
         pts.extend([g, key.point])
         scalars.extend([e * w % n, r * w % n])
-        meta.append((r, len(spans) - 1))
+        meta.append((r, valid))
+        valid += 1
     if not pts:
         return [False] * len(items)
     from bftkv_tpu.ops import ec as ec_ops

@@ -177,9 +177,9 @@ class RemoteVerifierDomain:
         if ln != expect:
             # Count mismatch: the sidecar rejected the frame, hit an
             # internal error (zero-length reply), or protocol skew —
-            # all resolve to LOCAL verification.
-            if ln:
-                self._recvall(min(ln, 1 << 20))
+            # all resolve to LOCAL verification.  No drain: the caller
+            # closes this connection on None, so leftover bytes can
+            # never desynchronize a reused stream.
             return None
         body = self._recvall(ln)
         if self._secret is not None:

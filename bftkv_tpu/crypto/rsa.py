@@ -290,6 +290,23 @@ class SignerDomain:
             )[:k]
             for pos, g in zip(device_pos, good):
                 ok[pos] = bool(g)
+            # The device check shares MXU/VPU machinery with the sign it
+            # polices; a systematic device defect could correlate across
+            # both.  Spot-check one random item per batch on the host —
+            # over many batches a correlated defect cannot stay hidden
+            # (ADVICE r3 low 3).
+            import secrets as _secrets
+
+            spot = device_pos[_secrets.randbelow(len(device_pos))]
+            _i, skey, sval = sigs[spot]
+            host_ok = pow(sval, skey.e, skey.n) == ems[spot]
+            if host_ok != ok[spot]:
+                metrics.incr("sign.fault_check_divergence")
+                log.error(
+                    "device fault check diverged from host spot check; "
+                    "trusting the host verdict"
+                )
+                ok[spot] = ok[spot] and host_ok
         return ok
 
     def sign_batch(self, items: list[tuple[bytes, "PrivateKey"]]) -> list[bytes]:
@@ -361,11 +378,28 @@ class SignerDomain:
             )[:k]
             vals = limb.limbs_to_ints(res)
             metrics.incr("sign.device", len(group))
+            sigs: list[tuple[int, object, int]] = []
             for j, (i, key, m, _domp, _domq, _dp, _dq, qinv) in enumerate(group):
                 m1, m2 = vals[2 * j], vals[2 * j + 1]
                 h = (qinv * (m1 - m2)) % key.p
                 s = m2 + h * key.q
-                out[i] = s.to_bytes(key.size_bytes, "big")
+                sigs.append((i, key, s))
+            # Same Boneh–DeMillo–Lipton gate as the RNS path: a single
+            # faulted CRT half from the limb kernel would leak the key
+            # via gcd(s^e − em, n) just the same (ADVICE r3 low 3).
+            ok = self._fault_check(sigs, group)
+            for (i, key, s), good, g in zip(sigs, ok, group):
+                if good:
+                    out[i] = s.to_bytes(key.size_bytes, "big")
+                else:
+                    metrics.incr("sign.fault")
+                    log.error(
+                        "limb sign fault check failed for one signature; "
+                        "re-signing on host"
+                    )
+                    out[i] = pow(g[2], key.d, key.n).to_bytes(
+                        key.size_bytes, "big"
+                    )
         if host_idx:
             metrics.incr("sign.host", len(host_idx))
         return out  # type: ignore[return-value]
@@ -471,6 +505,7 @@ class VerifierDomain:
 
     def verify_batch(self, items: list[tuple[bytes, bytes, PublicKey]]) -> np.ndarray:
         """Batched TPU verify of [(message, sig, key)] → (batch,) bool."""
+        from bftkv_tpu.crypto import cert as certmod  # lazy: cert imports rsa
         from bftkv_tpu.ops import rsa as rsa_ops
 
         out = np.zeros((len(items),), dtype=bool)
@@ -479,8 +514,6 @@ class VerifierDomain:
         ec_idx: list[int] = []
         ec_items: list = []
         for i, (message, sig_bytes, key) in enumerate(items):
-            from bftkv_tpu.crypto import cert as certmod
-
             if certmod.is_ec(key):
                 # ECDSA P-256 identity keys: batched device verify via
                 # ops.ec (two scalar mults per item in one launch).

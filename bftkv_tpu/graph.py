@@ -122,6 +122,26 @@ class Graph:
             n.active = True
         return peers
 
+    def add_local_edges(self, signer_id: int, signee_ids: list[int]) -> None:
+        """Operator-configured trust edges that exist ONLY in this
+        node's in-memory graph — never as certificate signatures, so
+        join gossip cannot propagate them to peers.  (A serialized
+        a→rw edge would combine with the rw→a edges rw nodes share in
+        their views into bidirectional cliques in *other* nodes'
+        graphs, silently reshaping their quorums — the
+        ``server_trust_rw`` incident, round 4.)"""
+        self._bump_generation()
+        sv = self.vertices.get(signer_id)
+        if sv is None:
+            sv = self.vertices[signer_id] = Vertex(instance=None)
+        for sid in signee_ids:
+            if sid in self.revoked:
+                continue
+            v = self.vertices.get(sid)
+            if v is None:
+                v = self.vertices[sid] = Vertex(instance=None)
+            sv.edges[sid] = v
+
     def get_peers(self) -> list:
         self_id = self.get_self_id()
         return [

@@ -481,12 +481,6 @@ class Client(Protocol):
         reqs = [pkt.serialize(v, None, 0, None, proof) for v in variables]
         ms: list[dict] = [{} for _ in range(n)]
         fails: list[list] = [[] for _ in range(n)]
-        # Per-item result frozen at FIRST threshold success, like the
-        # single path's early delivery: a later fabricated higher-t
-        # response from one Byzantine replica must not retroactively
-        # un-resolve an item (the single path is merely order-lucky
-        # here; freezing makes the batch deterministic).
-        resolved: list[tuple[bytes | None, int] | None] = [None] * n
 
         with metrics.timer("client.read_many.latency"):
 
@@ -514,33 +508,29 @@ class Client(Protocol):
                     )
                     if err is not None:
                         fails[k].append(err)
-                    elif resolved[k] is None:
-                        try:
-                            resolved[k] = self._max_timestamped_value(
-                                ms[k], q
-                            )
-                        except _InProgress:
-                            pass
                 return False  # consume the full quorum, as _read_worker does
 
             self.tr.multicast(
                 tp.BATCH_READ, q.nodes(), pkt.serialize_list(reqs), cb
             )
 
-            # Complete fan-out: fall back past fabricated lone high-t
-            # buckets, one device batch for every candidate signature
-            # across the whole batch (see _resolve_complete_fanout_many).
-            pending = [k for k in range(n) if resolved[k] is None]
-            if pending:
-                try:
-                    late = self._resolve_complete_fanout_many(
-                        [ms[k] for k in pending], q
-                    )
-                    for k, r in zip(pending, late):
-                        resolved[k] = r
-                except Exception as e:
-                    for k in pending:
-                        fails[k].append(e)
+            # Resolve ONCE over the complete fan-out.  The batch path
+            # consumes every response anyway (no early delivery to
+            # gain), and resolving per-response would freeze an item at
+            # the first threshold-reaching bucket — a stale value can
+            # hit threshold before a slower honest replica delivers the
+            # newest packet with its collective signature, making the
+            # result depend on arrival order.  Full-set resolution is
+            # deterministic: highest threshold-reaching bucket wins,
+            # and a *signed* strictly-newer candidate beats it; a
+            # fabricated lone high-t bucket has neither threshold nor a
+            # forgeable signature (see _resolve_complete_fanout_many).
+            resolved: list[tuple[bytes | None, int] | None] = [None] * n
+            try:
+                resolved = self._resolve_complete_fanout_many(ms, q)
+            except Exception as e:
+                for k in range(n):
+                    fails[k].append(e)
 
             results: list = []
             winners: list[tuple[int, bytes | None, int]] = []

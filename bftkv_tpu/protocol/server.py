@@ -30,6 +30,8 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
+from collections import OrderedDict
 
 from bftkv_tpu import packet as pkt
 from bftkv_tpu import quorum as qm
@@ -71,7 +73,15 @@ class Server(Protocol):
     def __init__(self, self_node, qs, tr, crypt, storage):
         super().__init__(self_node, qs, tr, crypt)
         self.storage = storage
-        self._auth: dict[bytes, authmod.AuthServer] = {}
+        # Per-variable TPA servers, LRU-bounded + idle-TTL'd: a hostile
+        # client naming fresh variables would otherwise grow this map
+        # without limit (the reference deletes on done/error,
+        # server.go:441-447; we keep sessions alive for mid-handshake
+        # peers, so bounding has to be explicit).  The anti-brute-force
+        # attempt counter survives eviction in ``_auth_attempts``.
+        self._auth: "OrderedDict[bytes, authmod.AuthServer]" = OrderedDict()
+        self._auth_used: dict[bytes, float] = {}
+        self._auth_attempts: "OrderedDict[bytes, int]" = OrderedDict()
         self._auth_lock = threading.Lock()
 
     # -- lifecycle (reference: server.go:47-62) ---------------------------
@@ -408,11 +418,46 @@ class Server(Protocol):
         self.storage.write(variable, 0, req)
         return None
 
+    #: Bounds on the per-variable AuthServer map: hard LRU cap plus an
+    #: idle TTL (entries idle longer are evicted opportunistically on
+    #: the next auth request).  Attempt counters survive eviction in
+    #: ``_auth_attempts`` (itself LRU-capped — 64k ints, not sessions).
+    AUTH_SESSIONS_MAX = 4096
+    AUTH_IDLE_TTL = 3600.0
+    AUTH_ATTEMPTS_MAX = 65536
+
+    def _auth_evict_locked(self, now: float) -> None:
+        """Evict idle/overflow AuthServers, preserving their attempt
+        counters; caller holds ``_auth_lock``."""
+
+        def retire(var: bytes, srv) -> None:
+            self._auth_used.pop(var, None)
+            if srv.attempts:
+                self._auth_attempts[var] = srv.attempts
+                self._auth_attempts.move_to_end(var)
+                if len(self._auth_attempts) > self.AUTH_ATTEMPTS_MAX:
+                    self._auth_attempts.popitem(last=False)
+
+        for var in [
+            v
+            for v, used in self._auth_used.items()
+            if now - used > self.AUTH_IDLE_TTL
+        ]:
+            retire(var, self._auth.pop(var))
+        while len(self._auth) > self.AUTH_SESSIONS_MAX:
+            var, srv = self._auth.popitem(last=False)
+            retire(var, srv)
+
     def _authenticate(self, req: bytes, peer, sender) -> bytes:
         phase, variable, adata = pkt.parse_auth_request(req)
         variable = variable or b""
+        now = time.monotonic()
         with self._auth_lock:
+            self._auth_evict_locked(now)
             a = self._auth.get(variable)
+            if a is not None:
+                self._auth.move_to_end(variable)
+                self._auth_used[variable] = now
         if a is None:
             try:
                 rdata = self.storage.read(variable, 0)
@@ -432,13 +477,20 @@ class Server(Protocol):
             # copies.
             with self._auth_lock:
                 a = self._auth.setdefault(variable, a)
+                self._auth.move_to_end(variable)
+                self._auth_used[variable] = now
+                # An evicted variable's brute-force penalty carries over.
+                carried = self._auth_attempts.pop(variable, 0)
+                if carried > a.attempts:
+                    a.attempts = carried
         # Unlike the reference (server.go:441-447, which deletes the
         # AuthServer on done *and* on error), the AuthServer stays in
-        # the map: the anti-brute-force counter must span client
-        # sessions or repeated wrong-password runs would each start
-        # from attempts=0, and a concurrent client mid-handshake must
-        # not lose its per-session DH state.  Per-session state is
-        # LRU-bounded inside AuthServer.
+        # the map while warm: the anti-brute-force counter must span
+        # client sessions or repeated wrong-password runs would each
+        # start from attempts=0, and a concurrent client mid-handshake
+        # must not lose its per-session DH state.  Per-session state is
+        # LRU-bounded inside AuthServer; the map itself is bounded by
+        # ``_auth_evict_locked`` with counters durable across eviction.
         try:
             res, done = a.make_response(
                 phase, adata or b"", session=(peer or sender).id
@@ -452,6 +504,8 @@ class Server(Protocol):
             raise
         if done:
             a.reset_attempts()  # successful login clears the penalty
+            with self._auth_lock:
+                self._auth_attempts.pop(variable, None)
         return res
 
     # -- enrollment (reference: server.go:450-514) ------------------------
@@ -649,13 +703,19 @@ class Server(Protocol):
             tbss_list.append(pkt.tbss(r))
             tbss_idx.append(i)
 
-        # One device batch for every collective-signature share.  No
-        # embedded cert: quorum members are in every keyring post-join,
-        # and B copies of our cert per response is pure bloat.
+        # One device batch for every collective-signature share.  The
+        # certificate is embedded ONCE (first share of the frame), not
+        # per item: a client whose keyring lacks this server's cert
+        # (mid-join) keeps single-path semantics — combine() merges the
+        # embedded cert and every later share of the frame resolves —
+        # without B copies of cert bloat per response (ADVICE r3 low 4).
         if tbss_list:
             shares = self.crypt.signer.issue_many(tbss_list, include_cert=False)
-            for share, i in zip(shares, tbss_idx):
+            cert_bytes = self.crypt.signer.cert.serialize()
+            for k, (share, i) in enumerate(zip(shares, tbss_idx)):
                 share.completed = False
+                if k == 0:
+                    share.cert = cert_bytes
                 results[i] = (None, pkt.serialize_signature(share))
                 metrics.incr("server.sign.ok")
 

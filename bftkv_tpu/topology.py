@@ -141,11 +141,23 @@ class Universe:
             for c in own:
                 if c.id in server_ids:
                     certmod.sign_certificate(c, identity.key)
-        elif self.server_trust_rw and identity.id in server_ids:
-            for c in own:
-                if c.id in rw_ids:
-                    certmod.sign_certificate(c, identity.key)
+        # server_trust_rw edges are deliberately NOT certificate
+        # signatures: see local_trust_of / Graph.add_local_edges — a
+        # serialized a→rw edge would leak to every peer via join
+        # responses and form bidirectional a↔rw cliques in their
+        # graphs, silently breaking client quorums post-join.
         return list(by_id.values())
+
+    def local_trust_of(self, identity: Identity) -> list[int]:
+        """Ids this principal trusts via LOCAL-ONLY graph edges (the
+        ``server_trust_rw`` operator extension): a daemon's own
+        client-API reads need the rw nodes in its read quorum, but the
+        edges must never serialize into certificates."""
+        if self.server_trust_rw and any(
+            s.id == identity.id for s in self.servers
+        ):
+            return [s.id for s in self.storage_nodes]
+        return []
 
 
 def build_universe(
@@ -231,11 +243,20 @@ def build_universe(
     )
 
 
-def save_home(path: str, identity: Identity, view: list[certmod.Certificate]) -> None:
+def save_home(
+    path: str,
+    identity: Identity,
+    view: list[certmod.Certificate],
+    local_trust: list[int] | None = None,
+) -> None:
     """Persist one principal's home directory: ``pubring`` (its whole
     certificate view) + ``secring`` (its private key) — the layout the
     daemon/CLI load, replacing the reference's per-node GnuPG key dirs
-    (reference: scripts/gen.sh, cmd/bftkv/main.go:69-72)."""
+    (reference: scripts/gen.sh, cmd/bftkv/main.go:69-72).
+
+    ``local_trust``: ids for local-only graph edges (``localtrust``
+    file, one hex id per line) — applied by :func:`load_home`, never
+    serialized into certificates."""
     import os
 
     from bftkv_tpu.crypto.keyring import Keyring
@@ -249,6 +270,9 @@ def save_home(path: str, identity: Identity, view: list[certmod.Certificate]) ->
     ring.register(ordered, priv=identity.key)
     ring.save_pubring(os.path.join(path, "pubring"))
     ring.save_secring(os.path.join(path, "secring"))
+    if local_trust:
+        with open(os.path.join(path, "localtrust"), "w") as f:
+            f.write("".join(f"{i:016x}\n" for i in local_trust))
 
 
 def load_home(path: str):
@@ -280,6 +304,11 @@ def load_home(path: str):
     graph = Graph()
     graph.set_self_nodes([self_cert])
     graph.add_peers([c for c in view if c.id != self_cert.id])
+    lt = os.path.join(path, "localtrust")
+    if os.path.exists(lt):
+        with open(lt) as f:
+            ids = [int(line, 16) for line in f if line.strip()]
+        graph.add_local_edges(self_cert.id, ids)
     crypt = Crypto(
         keyring=ring,
         signer=Signer(key, self_cert),
@@ -289,7 +318,11 @@ def load_home(path: str):
     return graph, crypt, WotQS(graph)
 
 
-def make_node(identity: Identity, view: list[certmod.Certificate]):
+def make_node(
+    identity: Identity,
+    view: list[certmod.Certificate],
+    local_trust: list[int] | None = None,
+):
     """Wire one node: trust graph with ``identity`` as self, every
     other principal in ``view`` as a peer, and a crypto bundle whose
     keyring holds the whole view (reference: cmd/bftkv/main.go:124-141
@@ -297,12 +330,16 @@ def make_node(identity: Identity, view: list[certmod.Certificate]):
 
     ``view`` is typically :meth:`Universe.view_of`; pass pre-parsed
     private copies — nodes must not share mutable certificate state.
+    ``local_trust`` (typically :meth:`Universe.local_trust_of`): ids
+    for in-memory-only trust edges.
     """
     self_cert = next(c for c in view if c.id == identity.cert.id)
 
     graph = Graph()
     graph.set_self_nodes([self_cert])
     graph.add_peers([c for c in view if c.id != self_cert.id])
+    if local_trust:
+        graph.add_local_edges(self_cert.id, local_trust)
 
     crypt = new_crypto(identity.key, self_cert)
     crypt.keyring.register(view)

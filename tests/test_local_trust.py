@@ -1,0 +1,110 @@
+"""Local-only trust edges (the server_trust_rw fix, round 4).
+
+The operator extension "servers trust rw nodes so the daemon's own
+client-API reads have a read quorum" used to be implemented as real
+certificate signatures — which leaked to every peer through join
+responses, formed bidirectional a↔rw edges in client graphs, and
+silently broke post-join writes (found by the round-4 verification
+drive).  The edges are now in-memory graph state that never
+serializes; these tests pin both halves: the capability works, and a
+client that joins afterward still has working quorums.
+"""
+
+from __future__ import annotations
+
+from bftkv_tpu import topology
+from bftkv_tpu.protocol.client import Client
+from bftkv_tpu.protocol.server import Server
+from bftkv_tpu.storage.memkv import MemStorage
+from bftkv_tpu.transport.loopback import LoopbackNet, TrLoopback
+
+
+def _build(server_trust_rw: bool):
+    uni = topology.build_universe(
+        4, 1, 4, bits=1024, server_trust_rw=server_trust_rw
+    )
+    net = LoopbackNet()
+    servers = []
+    for ident in uni.servers + uni.storage_nodes:
+        graph, crypt, qs = topology.make_node(
+            ident, uni.view_of(ident), local_trust=uni.local_trust_of(ident)
+        )
+        srv = Server(graph, qs, TrLoopback(crypt, net), crypt, MemStorage())
+        srv.start()
+        servers.append(srv)
+    u = uni.users[0]
+    graph, crypt, qs = topology.make_node(u, uni.view_of(u))
+    client = Client(graph, qs, TrLoopback(crypt, net), crypt)
+    return uni, servers, client
+
+
+def test_local_edges_never_serialize():
+    uni, servers, client = _build(server_trust_rw=True)
+    try:
+        rw_ids = {s.id for s in uni.storage_nodes}
+        for srv in servers[:4]:  # the a* quorum servers
+            # The local edges exist in the server's own graph…
+            sv = srv.self_node.vertices[srv.self_node.get_self_id()]
+            assert rw_ids <= set(sv.edges), "local trust edges missing"
+            # …but never in the certificates it would serialize to a
+            # joining peer: no rw id appears in any a-cert's signers.
+            from bftkv_tpu.crypto import cert as certmod
+
+            for c in certmod.parse(srv.self_node.serialize_nodes()):
+                assert not (set(c.signers()) & rw_ids) or c.id in rw_ids
+    finally:
+        for s in servers:
+            s.tr.stop()
+
+
+def test_write_survives_join_with_server_trust_rw():
+    # The regression: joining used to import the leaked a→rw edges and
+    # break the client's quorums ("insufficient number of responses").
+    uni, servers, client = _build(server_trust_rw=True)
+    try:
+        client.write(b"lt/pre", b"before-join")
+        assert client.read(b"lt/pre") == b"before-join"
+        client.joining()
+        client.write(b"lt/post", b"after-join")
+        assert client.read(b"lt/post") == b"after-join"
+        assert client.write_many(
+            [(b"lt/b/%d" % i, b"v%d" % i) for i in range(4)]
+        ) == [None] * 4
+    finally:
+        for s in servers:
+            s.tr.stop()
+
+
+def test_daemon_reads_have_quorum_with_local_trust(tmp_path):
+    # The capability the flag exists for: a server's own client can
+    # READ (rw nodes complete its read quorum) — via the load_home
+    # localtrust file path the daemon uses.
+    uni = topology.build_universe(4, 1, 4, bits=1024, server_trust_rw=True)
+    for ident in uni.all:
+        topology.save_home(
+            str(tmp_path / ident.name), ident, uni.view_of(ident),
+            local_trust=uni.local_trust_of(ident),
+        )
+    net = LoopbackNet()
+    servers = []
+    triples = {}
+    for ident in uni.servers + uni.storage_nodes:
+        graph, crypt, qs = topology.load_home(str(tmp_path / ident.name))
+        triples[ident.name] = (graph, crypt, qs)
+        srv = Server(graph, qs, TrLoopback(crypt, net), crypt, MemStorage())
+        srv.start()
+        servers.append(srv)
+    try:
+        # A user writes a value…
+        u = uni.users[0]
+        g, crypt, qs = topology.load_home(str(tmp_path / u.name))
+        cl = Client(g, qs, TrLoopback(crypt, net), crypt)
+        cl.write(b"lt/d", b"daemon-visible")
+        # …and the a01 daemon's own client (its graph carries the
+        # localtrust edges) can read it back.
+        g1, c1, q1 = triples["a01"]
+        own = Client(g1, q1, servers[0].tr, c1)
+        assert own.read(b"lt/d") == b"daemon-visible"
+    finally:
+        for s in servers:
+            s.tr.stop()

@@ -1,0 +1,91 @@
+"""Server session-state bounds (VERDICT r3 weak #5 / item 8).
+
+A long-lived daemon must not accumulate unbounded per-variable TPA
+state or per-peer transport sessions from hostile traffic.  These
+tests flood the seams and assert the maps stay bounded — while the
+anti-brute-force attempt counter survives eviction (the property that
+justified keeping sessions alive in the first place).
+"""
+
+from __future__ import annotations
+
+from bftkv_tpu.crypto import cert as certmod
+from bftkv_tpu.crypto import rsa
+from bftkv_tpu.crypto.message import MessageSecurity
+
+
+def _cluster():
+    from tests.cluster_utils import start_cluster
+
+    return start_cluster(4, 1, 4)
+
+
+def test_auth_session_map_bounded():
+    c = _cluster()
+    try:
+        srv = c.servers[0]
+        srv.AUTH_SESSIONS_MAX = 16
+        cl = c.clients[0]
+        # Flood distinct protected variables: each authenticate builds
+        # an AuthServer per replica (reference: server.go:405-434).
+        for i in range(28):
+            var = b"flood/%d" % i
+            cl.authenticate(var, b"pw-%d" % i)  # seeds params + auths
+        assert len(srv._auth) <= 16, len(srv._auth)
+        for s in c.all_servers:
+            assert len(s._auth) <= 4096
+        # The hottest entry still authenticates after the flood.
+        proof, _ = cl.authenticate(b"flood/27", b"pw-27")
+        assert proof is not None
+    finally:
+        c.stop()
+
+
+def test_auth_attempts_survive_eviction():
+    # Eviction must not reset the brute-force penalty: retire a hot
+    # AuthServer with attempts, then re-create it — counter carries.
+    c = _cluster()
+    try:
+        cl = c.clients[0]
+        var = b"bf/x"
+        cl.authenticate(var, b"right")  # creates the auth data + sessions
+        srv = c.servers[0]
+        assert var in srv._auth
+        srv._auth[var].attempts = 3
+        # Force eviction via the TTL path.
+        with srv._auth_lock:
+            srv._auth_evict_locked(now=1e12)
+        assert var not in srv._auth
+        assert srv._auth_attempts.get(var) == 3
+        # Next authenticate rebuilds the AuthServer WITH the carried
+        # count (consumed from _auth_attempts at rebuild).  The client
+        # needs only k of n for the final phase, so this server may not
+        # observe "done": its counter is either reset (0) or the seeded
+        # 3 plus this run's attempt — never restarted from scratch.
+        cl.authenticate(var, b"right")
+        assert var in srv._auth
+        assert var not in srv._auth_attempts
+        assert srv._auth[var].attempts in (0, 3, 4), srv._auth[var].attempts
+        # At least one replica completed the handshake and cleared it.
+        assert any(
+            s._auth.get(var) is not None and s._auth[var].attempts == 0
+            for s in c.servers
+        )
+    finally:
+        c.stop()
+
+
+def test_message_security_tables_bounded():
+    key = rsa.generate(1024)
+    cert = certmod.Certificate(n=key.n, e=key.e, name="m")
+    ms = MessageSecurity(key, cert)
+    ms._CACHE_MAX = 64
+    # 200 distinct "peers" bootstrap sessions at us.
+    for i in range(200):
+        pk = rsa.generate(1024)
+        pc = certmod.Certificate(n=pk.n, e=pk.e, name="p%d" % i)
+        peer = MessageSecurity(pk, pc)
+        blob = peer.encrypt([cert], b"hi", b"n%d" % i)
+        ms.decrypt(blob)
+    assert len(ms._by_id) <= 64
+    assert len(ms._by_peer) <= 64
