@@ -1022,11 +1022,15 @@ def main() -> None:
     use_cache = os.environ.get("BENCH_NO_CACHE") != "1"
 
     if FAST:
-        default_configs = "kernel,rns,sign,modexp,ec,c4,c16,b16,tally"
+        default_configs = "rns,sign,b16,kernel,modexp,ec,c4,c16,tally"
     else:
+        # Headline-bearing sections FIRST: a tunnel that lives only a
+        # few minutes still captures the numbers that matter most
+        # (cluster_64_batched is the headline; rns/sign are the kernel
+        # story), and BENCH_partial.json keeps whatever landed.
         default_configs = (
-            "kernel,rns,sign,modexp,ec,c4,c4http,c4ec,c16,c64,"
-            "b16,b64,bmix64,bmix64ec,thr,tally"
+            "rns,sign,b64,c64,b16,bmix64,bmix64ec,kernel,modexp,ec,"
+            "c4,c4http,c4ec,c16,thr,tally"
         )
     configs = [t for t in _env_list("BENCH_CONFIGS", default_configs)
                if t in SECTION_NAMES]

@@ -298,9 +298,16 @@ def scalar_mult_hosts(points: list, scalars: list[int]) -> list:
     if not points:
         return []
     if _use_rns_backend():
-        from bftkv_tpu.ops import ec_rns
+        try:
+            from bftkv_tpu.ops import ec_rns
 
-        return ec_rns.scalar_mult_hosts(points, scalars)
+            return ec_rns.scalar_mult_hosts(points, scalars)
+        except Exception:
+            import logging
+
+            logging.getLogger("bftkv_tpu.ops.ec").exception(
+                "RNS EC backend failed; falling back to the limb kernel"
+            )
     d = p256()
     k = len(points)
     padded = max(8, 1 << (k - 1).bit_length())

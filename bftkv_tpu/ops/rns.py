@@ -592,19 +592,30 @@ def power_mod_rns(
         np.asarray(idxs, dtype=np.int32),
         ukey,
     )
+    sigma = None
     if _use_pallas("BFTKV_RNS_POW_BACKEND"):
-        from bftkv_tpu.ops import pallas_rns
+        try:
+            from bftkv_tpu.ops import pallas_rns
 
-        sigma = np.asarray(
-            pallas_rns.pow_pallas(
-                *pow_args, digits=digits, n_bits=n_bits
+            sigma = np.asarray(
+                pallas_rns.pow_pallas(
+                    *pow_args, digits=digits, n_bits=n_bits
+                )
+            )[:t]
+        except Exception:
+            # A Mosaic compile/runtime failure must degrade to the XLA
+            # kernel, not sink the sign path — but loudly: a silent
+            # fallback would misattribute every benchmark number.
+            import logging
+
+            logging.getLogger("bftkv_tpu.ops.rns").exception(
+                "pallas pow kernel failed; falling back to XLA"
             )
-        )[:t]
-    elif _shardable(padded):
+    if sigma is None and _shardable(padded):
         sigma = np.asarray(
             _jitted_pow_sharded(digits, n_bits)(*pow_args)
         )[:t]
-    else:
+    elif sigma is None:
         sigma = np.asarray(_jitted_pow(digits, n_bits)(*pow_args))[:t]
     vals = _sigma_to_ints(ctx, sigma)
     return [v % m for v, m in zip(vals, mods[:t])]
@@ -754,9 +765,16 @@ def verify_e65537_rns_indexed(
     em_h = digits_to_halves_u8(np.asarray(em_digits))
     idx = np.asarray(key_idx, dtype=np.int32)
     if _use_pallas("BFTKV_RNS_VERIFY_BACKEND"):
-        from bftkv_tpu.ops import pallas_rns
+        try:
+            from bftkv_tpu.ops import pallas_rns
 
-        return pallas_rns.verify_pallas(sig_h, em_h, idx, unique_rows)
+            return pallas_rns.verify_pallas(sig_h, em_h, idx, unique_rows)
+        except Exception:
+            import logging
+
+            logging.getLogger("bftkv_tpu.ops.rns").exception(
+                "pallas verify kernel failed; falling back to XLA"
+            )
     if _shardable(sig_h.shape[0]):
         return _jitted_verify_gather_sharded()(sig_h, em_h, idx, unique_rows)
     return _jitted_verify_gather()(sig_h, em_h, idx, unique_rows)
