@@ -13,6 +13,14 @@ module applies the RNS playbook that made RSA fast
   exact bf16 MXU matmuls — no emulated integer arithmetic anywhere;
 - the modulus is FIXED (the P-256 prime), so all Montgomery/extension
   constants are compile-time and broadcast — zero per-row key traffic;
+- **channel-major layout**: tensors are ``(k, T)`` — batch rides the
+  lane (minor) axis, channels ride sublanes.  P-256's k is only 27
+  per base; channels-minor would lane-pad 27 → 128 (4.7× VPU waste on
+  every Barrett op), while batch-minor keeps all 128 lanes busy and
+  pads sublanes just 27 → 32.  (The RSA contexts sit at k = 94/188
+  where channels-minor padding is mild; here layout is the difference
+  between a VPU-bound and a balanced kernel.)  Base extensions become
+  ``Eᵀ @ x`` matmuls — same exact 6-bit-split bf16 MXU scheme;
 - values are kept in redundant AMM form (< c·p for a tracked
   coefficient c); adds and subtracts are channelwise and *don't*
   reduce — only the Montgomery product does (every ``fmul`` output is
@@ -67,33 +75,140 @@ _S_SMALL = 32
 _S_L1 = 1 << 14
 _S_L2 = 1 << 16
 
+_PRF = np.float32(rns.PR)
+_INV_PRF = np.float32(1.0 / rns.PR)
+_I64 = np.float32(1.0 / 64.0)
+
+
+# -- channel-major field primitives (tensors (k, T); constants (k, 1)) --
+
+
+def _barrett(x, inv_p, p):
+    q = jnp.floor(x * inv_p)
+    r = x - q * p
+    r = jnp.where(r < 0, r + p, r)
+    r = jnp.where(r < 0, r + p, r)
+    r = jnp.where(r >= p, r - p, r)
+    r = jnp.where(r >= p, r - p, r)
+    return r
+
+
+def _mulmod(a, b, inv_p, p):
+    return _barrett(a * b, inv_p, p)
+
+
+def _addmod(a, b, p):
+    s = a + b
+    return jnp.where(s >= p, s - p, s)
+
+
+def _submod(a, b, p):
+    d = a - b
+    return jnp.where(d < 0, d + p, d)
+
+
+def _mod_r(x):
+    return x - jnp.floor(x * _INV_PRF) * _PRF
+
+
+def _split6(x):
+    hi = jnp.floor(x * _I64)
+    return x - hi * 64.0, hi
+
+
+def _dot(m, x):
+    return lax.dot_general(
+        m.astype(jnp.bfloat16),
+        x.astype(jnp.bfloat16),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _dot6(mlo, mhi, x):
+    """Exact M @ x for 12-bit integral operands via 6-bit bf16 planes:
+    M is pre-split (rows = output channels), x is (k, T)."""
+    xlo, xhi = _split6(x)
+    return (
+        _dot(mlo, xlo),
+        _dot(mlo, xhi) + _dot(mhi, xlo),
+        _dot(mhi, xhi),
+    )
+
+
+def _red6(rlo, rhi, x):
+    """Redundant-channel row-reduce: Σ_i r[i]·x[i, :] → (1, T) planes."""
+    xlo, xhi = _split6(x)
+    s = lambda v: jnp.sum(v, axis=0, keepdims=True)
+    return (
+        s(rlo * xlo),
+        s(rlo * xhi) + s(rhi * xlo),
+        s(rhi * xhi),
+    )
+
+
+def _combine(sll, smid, shh, inv_p, p):
+    a = _barrett(sll, inv_p, p)
+    b = _barrett(smid, inv_p, p)
+    d = _barrett(shh, inv_p, p)
+    b6 = _barrett(b * 64.0, inv_p, p)
+    d12 = _barrett(_barrett(d * 64.0, inv_p, p) * 64.0, inv_p, p)
+    return _addmod(_addmod(a, b6, p), d12, p)
+
+
+def _combine_r(sll, smid, shh):
+    return _mod_r(
+        _mod_r(sll) + _mod_r(smid * 64.0) + _mod_r(_mod_r(shh * 64.0) * 64.0)
+    )
+
 
 class _P256RNS:
-    """Fixed-modulus RNS field context + device constants."""
+    """Fixed-modulus RNS field context, channel-major device constants."""
 
     def __init__(self):
         ctx = rns.context(_DIGITS, 256)
         self.ctx = ctx
-        self.cn = rns._Consts(ctx)
-        self.k = ctx.k
+        self.k = k = ctx.k
         p = P256.p
-        key = ctx.key_rows(p)
-        self.key = tuple(
-            jnp.asarray(
-                np.asarray(a)[None]
-                if np.ndim(a)
-                else np.full((1, 1), a, dtype=np.float32)
-            )
-            for a in key
-        )
         f32 = lambda xs: np.asarray(xs, dtype=np.float32)
+        col = lambda xs: jnp.asarray(f32(xs)[:, None])  # (k, 1)
+
+        self.pb = col(ctx.p_all[:k])
+        self.pq = col(ctx.p_all[k:])
+        self.ib = col(1.0 / ctx.p_all[:k])
+        self.iq = col(1.0 / ctx.p_all[k:])
+        self.invMi_b = col(ctx.invMi_b)
+        self.invMi_q = col(ctx.invMi_q)
+        self.Mq_mod_b = col(ctx.Mq_mod_b)
+        self.invM_q = col(ctx.invM_q)
+        self.invMq_pr = np.float32(ctx.invMq_pr)
+        self.invM_pr = np.float32(ctx.invM_pr)
+        nrow = ctx.key_rows(p)
+        n_all = np.asarray(nrow[0])
+        self.nb = col(n_all[:k])
+        self.nq = col(n_all[k:])
+        self.nr = jnp.asarray(np.full((1, 1), float(nrow[1]), np.float32))
+        self.neg_ninv_b = col(np.asarray(nrow[2]))
+
+        # Extension matrices, pre-transposed for Eᵀ @ x and pre-split.
+        E1 = (ctx._E1[0] + 64.0 * ctx._E1[1]).astype(np.int64)  # (k, k+1)
+        E2 = (ctx._E2[0] + 64.0 * ctx._E2[1]).astype(np.int64)
+        split = lambda m: (
+            jnp.asarray((m & 63).astype(np.float32)),
+            jnp.asarray((m >> 6).astype(np.float32)),
+        )
+        self.E1qT = split(E1[:, :k].T)  # (k_q, k_b)
+        self.E1r = split(E1[:, k:])  # (k_b, 1) column, used as reduce
+        self.E2bT = split(E2[:, :k].T)
+        self.E2r = split(E2[:, k:])
+
+        self.pinv_b = col([pow(p % q, -1, q) for q in ctx.pb])
 
         def const_of(v: int):
-            """Residues of integer v as a broadcastable RNS triplet."""
             return (
-                jnp.asarray(f32([v % q for q in ctx.pb])[None]),
-                jnp.asarray(f32([v % q for q in ctx.pq])[None]),
-                jnp.asarray(np.full((1, 1), v % rns.PR, dtype=np.float32)),
+                col([v % q for q in ctx.pb]),
+                col([v % q for q in ctx.pq]),
+                jnp.asarray(np.full((1, 1), v % rns.PR, np.float32)),
             )
 
         self.sp = {
@@ -101,36 +216,55 @@ class _P256RNS:
             _S_L1: const_of(_S_L1 * p),
             _S_L2: const_of(_S_L2 * p),
         }
-        # p⁻¹ mod p_j over base B — the is_zero α extractor.
-        self.pinv_b = jnp.asarray(
-            f32([pow(p % q, -1, q) for q in ctx.pb])[None]
-        )
-        r_int = ctx.M % p  # the Montgomery "one"
-        self.one_m = const_of(r_int)
+        self.one_m = const_of(ctx.M % p)
         self.zero = const_of(0)
 
-    # -- field ops (triplets (xb (T,k), xq (T,k), xr (T,1))) -----------
+    # -- field ops (triplets (xb (k,T), xq (k,T), xr (1,T))) -----------
 
     def fmul(self, a, b):
-        return rns._mont_mul(self.cn, a, b, self.key)
+        """RNS Montgomery product (Bajard AMM + Shenoy), channel-major."""
+        ab, aq, ar = a
+        bb, bq, br = b
+        db = _mulmod(ab, bb, self.ib, self.pb)
+        dq = _mulmod(aq, bq, self.iq, self.pq)
+        dr = _mod_r(ar * br)
+
+        qb = _mulmod(db, self.neg_ninv_b, self.ib, self.pb)
+        sigma = _mulmod(qb, self.invMi_b, self.ib, self.pb)
+        sll, smid, shh = _dot6(*self.E1qT, sigma)
+        qhat_q = _combine(sll, smid, shh, self.iq, self.pq)
+        rll, rmid, rhh = _red6(*self.E1r, sigma)
+        qhat_r = _combine_r(rll, rmid, rhh)
+
+        t = _mulmod(qhat_q, self.nq, self.iq, self.pq)
+        rq = _mulmod(_addmod(dq, t, self.pq), self.invM_q, self.iq, self.pq)
+        rr = _mod_r(_mod_r(dr + _mod_r(qhat_r * self.nr)) * self.invM_pr)
+
+        sigma2 = _mulmod(rq, self.invMi_q, self.iq, self.pq)
+        zll, zmid, zhh = _dot6(*self.E2bT, sigma2)
+        ext_b = _combine(zll, zmid, zhh, self.ib, self.pb)
+        wll, wmid, whh = _red6(*self.E2r, sigma2)
+        ext_r = _combine_r(wll, wmid, whh)
+        alpha = _mod_r(_mod_r(ext_r - rr + _PRF) * self.invMq_pr)
+        corr = _barrett(alpha * self.Mq_mod_b, self.ib, self.pb)
+        rb = _submod(ext_b, corr, self.pb)
+        return rb, rq, rr
 
     def fadd(self, a, b):
-        cn = self.cn
         return (
-            rns._addmod(a[0], b[0], cn.pb),
-            rns._addmod(a[1], b[1], cn.pq),
-            rns._mod_r(a[2] + b[2]),
+            _addmod(a[0], b[0], self.pb),
+            _addmod(a[1], b[1], self.pq),
+            _mod_r(a[2] + b[2]),
         )
 
     def fsub(self, a, b, s: int = _S_L1):
         """a − b + s·p (s·p ≡ 0 mod p keeps the residue class; s must
         exceed b's bound coefficient so the value stays positive)."""
         sp = self.sp[s]
-        cn = self.cn
         return (
-            rns._addmod(rns._submod(a[0], b[0], cn.pb), sp[0], cn.pb),
-            rns._addmod(rns._submod(a[1], b[1], cn.pq), sp[1], cn.pq),
-            rns._mod_r(a[2] - b[2] + sp[2] + rns._PRF),
+            _addmod(_submod(a[0], b[0], self.pb), sp[0], self.pb),
+            _addmod(_submod(a[1], b[1], self.pq), sp[1], self.pq),
+            _mod_r(a[2] - b[2] + sp[2] + _PRF),
         )
 
     def fdbl(self, a):
@@ -138,16 +272,15 @@ class _P256RNS:
 
     def is_zero(self, v):
         """(T,) bool: v ≡ 0 (mod p), exact for v < (min prime)·p."""
-        cn = self.cn
-        w = rns._mulmod(v[0], self.pinv_b, cn.ib, cn.pb)
-        alpha = w[:, :1]
-        return jnp.all(w == alpha, axis=1) & (
-            alpha[:, 0] <= np.float32(2 * _S_SMALL)
+        w = _mulmod(v[0], self.pinv_b, self.ib, self.pb)
+        alpha = w[:1, :]
+        return jnp.all(w == alpha, axis=0) & (
+            alpha[0, :] <= np.float32(2 * _S_SMALL)
         )
 
     def select(self, cond, a, b):
         """Per-lane triplet select; cond is (T,)."""
-        c = cond[:, None]
+        c = cond[None, :]
         return tuple(jnp.where(c, x, y) for x, y in zip(a, b))
 
     # -- group law (Jacobian, unified / branch-free) -------------------
@@ -239,13 +372,13 @@ class _P256RNS:
     def _ints_to_res(self, vals: list[int]):
         ctx = self.ctx
         t = len(vals)
-        out_b = np.empty((t, self.k), dtype=np.float32)
-        out_q = np.empty((t, self.k), dtype=np.float32)
-        out_r = np.empty((t, 1), dtype=np.float32)
+        out_b = np.empty((self.k, t), dtype=np.float32)
+        out_q = np.empty((self.k, t), dtype=np.float32)
+        out_r = np.empty((1, t), dtype=np.float32)
         for i, v in enumerate(vals):
-            out_b[i] = [v % q for q in ctx.pb]
-            out_q[i] = [v % q for q in ctx.pq]
-            out_r[i, 0] = v % rns.PR
+            out_b[:, i] = [v % q for q in ctx.pb]
+            out_q[:, i] = [v % q for q in ctx.pq]
+            out_r[0, i] = v % rns.PR
         return (jnp.asarray(out_b), jnp.asarray(out_q), jnp.asarray(out_r))
 
     def decode_points(self, X, Y, Z) -> list:
@@ -258,10 +391,8 @@ class _P256RNS:
         outs = []
         for comp in (X, Y, Z):
             plain = self.fmul(comp, ones)  # strip the Montgomery factor
-            sigma = rns._mulmod(
-                plain[0], self.cn.invMi_b, self.cn.ib, self.cn.pb
-            )
-            vals = rns._sigma_to_ints(ctx, np.asarray(sigma))
+            sigma = _mulmod(plain[0], self.invMi_b, self.ib, self.pb)
+            vals = rns._sigma_to_ints(ctx, np.asarray(sigma).T)
             outs.append([v % p for v in vals])
         xs, ys, zs = outs
         pts = []
@@ -280,10 +411,8 @@ def _engine() -> _P256RNS:
     return _P256RNS()
 
 
-def _bcast(c, like):
-    return tuple(
-        jnp.broadcast_to(a, (like.shape[0],) + a.shape[1:]) for a in c
-    )
+def _bcast(c, t: int):
+    return tuple(jnp.broadcast_to(a, (a.shape[0], t)) for a in c)
 
 
 @functools.lru_cache(maxsize=1)
@@ -292,36 +421,33 @@ def _scalar_mult_fn():
 
     def run(Xb, Xq, Xr, Yb, Yq, Yr, Zb, Zq, Zr, nibbles_t):
         P = ((Xb, Xq, Xr), (Yb, Yq, Yr), (Zb, Zq, Zr))
-        one_m = _bcast(eng.one_m, Xb)
-        ident = (one_m, one_m, _bcast(eng.zero, Xb))
+        t = Xb.shape[1]
+        one_m = _bcast(eng.one_m, t)
+        ident = (one_m, one_m, _bcast(eng.zero, t))
         # Window table t[j] = j·P (t[0] = identity), 15 unified adds.
         tab = [ident, P]
         for _ in range(14):
             tab.append(eng.jac_add(tab[-1], P))
-        k = eng.k
-        # Concatenate per coordinate/component for the one-hot select.
+        # Stack on a leading window axis for the one-hot select.
         cat = [
-            [jnp.concatenate([t[i][j] for t in tab], axis=1)
-             for j in range(3)]
+            [jnp.stack([w[i][j] for w in tab]) for j in range(3)]
             for i in range(3)
         ]
 
-        def sel(nib, i):
-            comps = []
-            for j, width in ((0, k), (1, k), (2, 1)):
-                tcat = cat[i][j]
-                acc = jnp.zeros_like(tcat[:, :width])
-                for w in range(16):
-                    m = (nib == np.float32(w)).astype(jnp.float32)
-                    acc = acc + m * tcat[:, w * width : (w + 1) * width]
-                comps.append(acc)
-            return tuple(comps)
+        def sel(mask16, i):
+            # mask16: (16, 1, T) one-hot; reduce over the window axis.
+            return tuple(
+                jnp.sum(mask16 * cat[i][j], axis=0) for j in range(3)
+            )
 
         def body(acc, nib):
             for _ in range(_WINDOW):
                 acc = eng.jac_double(*acc)
-            nibc = nib[:, None]
-            q = (sel(nibc, 0), sel(nibc, 1), sel(nibc, 2))
+            m16 = (
+                nib[None, None, :]
+                == jnp.arange(16, dtype=jnp.float32)[:, None, None]
+            ).astype(jnp.float32)
+            q = (sel(m16, 0), sel(m16, 1), sel(m16, 2))
             return eng.jac_add(acc, q), None
 
         acc, _ = lax.scan(body, ident, nibbles_t)
