@@ -1,0 +1,108 @@
+"""Byzantine + threshold-CA scenarios on ECDSA P-256 identity
+universes: the "zero additional safety violations" gate must hold
+regardless of the identity-key algorithm (the adversary machinery in
+mal_utils is algorithm-agnostic by construction, like the reference's
+PGP layer — crypto_pgp.go:310-405).
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from bftkv_tpu import topology
+from bftkv_tpu.transport.loopback import TrLoopback
+
+from cluster_utils import start_cluster
+from mal_utils import MalClient, MalServer, MalStorage
+
+
+@pytest.fixture()
+def ec_mal_cluster():
+    c = start_cluster(
+        n_servers=7,
+        n_users=2,
+        n_rw=6,
+        server_cls=MalServer,
+        storage_factory=MalStorage,
+        alg="p256",
+    )
+    mal = {i.cert.address for i in c.universe.servers[-3:]}
+    mal |= {i.cert.address for i in c.universe.storage_nodes[-2:]}
+    MalServer.mal_addresses = mal
+    try:
+        yield c, mal
+    finally:
+        MalServer.mal_addresses = set()
+        c.stop()
+
+
+def test_ec_collusion_convergence_and_revocation(ec_mal_cluster):
+    """Equivocation with EC-signed packets: the honest reader converges
+    and the EC double-signers are revoked (mal_test.go:23-71, on
+    P-256 identities)."""
+    c, mal = ec_mal_cluster
+    uni = c.universe
+
+    evil_ident = uni.users[0]
+    graph, crypt, qs = topology.make_node(evil_ident, uni.view_of(evil_ident))
+    evil = MalClient(
+        graph, qs, TrLoopback(crypt, c.net), crypt, mal_addresses=mal
+    )
+    evil.write_mal(b"ec_mal", b"value-one", b"value-two")
+
+    honest = c.clients[1]
+    value = honest.read(b"ec_mal")
+    assert value in (b"value-one", b"value-two")
+
+    deadline = time.time() + 10
+    mal_server_ids = {i.cert.id for i in uni.servers[-3:]}
+    while time.time() < deadline:
+        if mal_server_ids <= set(honest.self_node.revoked):
+            break
+        time.sleep(0.05)
+    assert mal_server_ids <= set(honest.self_node.revoked)
+    assert evil_ident.cert.id in honest.self_node.revoked
+
+
+def test_ec_batch_pipeline_survives_colluders(ec_mal_cluster):
+    c, _ = ec_mal_cluster
+    honest = c.clients[1]
+    items = [(b"ec_sane/%d" % i, b"v%d" % i) for i in range(8)]
+    assert honest.write_many(items) == [None] * 8
+    assert honest.read_many([v for v, _ in items]) == [v for _, v in items]
+
+
+def test_threshold_ca_on_ec_identity_cluster():
+    """The decentralized CA over a pure-EC identity cluster: RSA and
+    ECDSA CA keys distribute (shares ECIES-encrypted per recipient via
+    the message layer) and threshold-sign with verifiable output
+    (reference: protocol/dist_test.go:29-105)."""
+    from bftkv_tpu.crypto import rsa as rsamod
+    from bftkv_tpu.crypto.ec import P256
+    from bftkv_tpu.crypto.threshold import ThresholdAlgo
+    from bftkv_tpu.crypto.threshold.ecdsa import generate as ec_generate
+
+    c = start_cluster(9, 1, 4, alg="p256")
+    try:
+        cl = c.clients[0]
+        ca_rsa = rsamod.generate(2048)
+        cl.distribute("ecu-rsa", ca_rsa)
+        sig = cl.dist_sign("ecu-rsa", b"tbs-1", ThresholdAlgo.RSA, "sha256")
+        em = rsamod.emsa_pkcs1v15_sha256(b"tbs-1", ca_rsa.size_bytes)
+        assert pow(int.from_bytes(sig, "big"), ca_rsa.e, ca_rsa.n) == em
+
+        ca_ec = ec_generate()
+        cl.distribute("ecu-ec", ca_ec)
+        sig2 = cl.dist_sign("ecu-ec", b"tbs-2", ThresholdAlgo.ECDSA, "sha256")
+        # Threshold ECDSA emits raw r(32)‖s(32) — the same wire form as
+        # identity ECDSA; verify against the CA public key directly.
+        from bftkv_tpu.crypto import ecdsa as id_ecdsa
+
+        pub_pt = P256.scalar_base_mult(ca_ec.d)
+        pub = id_ecdsa.ECPublicKey(x=pub_pt[0], y=pub_pt[1])
+        assert len(sig2) == 64
+        assert id_ecdsa.verify_host(b"tbs-2", sig2, pub)
+    finally:
+        c.stop()
