@@ -34,7 +34,9 @@ def run_cmd(args, **kw):
     )
 
 
-def wait_port(port: int, timeout: float = 60.0) -> None:
+def wait_port(port: int, timeout: float = 180.0) -> None:
+    # Generous: a co-scheduled test suite or bench run can stretch 9
+    # daemons' jax imports well past a minute on a shared CPU box.
     import socket
 
     deadline = time.monotonic() + timeout
