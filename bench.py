@@ -32,6 +32,7 @@ BENCH_BATCH=N (batched-pipeline sections)  BENCH_BACKEND_TIMEOUT=secs
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import sys
@@ -797,6 +798,41 @@ def bench_tally(universe: int = 256, n_byz: int = 85, batch: int = 4096) -> dict
 # ---------------------------------------------------------------------------
 
 PARTIAL_PATH = os.path.join(REPO, "BENCH_partial.json")
+DETAIL_PATH = os.path.join(REPO, "BENCH_detail.json")
+
+
+@functools.lru_cache(maxsize=1)
+def _code_fingerprint() -> str:
+    """Short hash over the framework + bench sources.
+
+    Cached TPU captures are stamped with this so a capture made before a
+    kernel change is visibly stale (`cached_stale_code`) when spliced
+    into a later record.  Docs/tests don't affect it: only code that can
+    change a measurement (bftkv_tpu/, native/, bench.py) is hashed.
+    """
+    import hashlib
+
+    h = hashlib.sha256()
+    roots = [os.path.join(REPO, "bftkv_tpu"), os.path.join(REPO, "native")]
+    files = [os.path.join(REPO, "bench.py")]
+    for root in roots:
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+            files.extend(
+                os.path.join(dirpath, f)
+                for f in filenames
+                if f.endswith((".py", ".c", ".cpp", ".cc", ".h", ".hpp"))
+            )
+    for path in sorted(files):
+        try:
+            with open(path, "rb") as f:
+                # Relative paths: the fingerprint must survive the repo
+                # being checked out elsewhere.
+                h.update(os.path.relpath(path, REPO).encode())
+                h.update(f.read())
+        except OSError:
+            pass
+    return h.hexdigest()[:12]
 
 # token -> extra-dict section name.  Order = run order.
 SECTION_NAMES = {
@@ -1079,6 +1115,7 @@ def main() -> None:
                         "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
                     ),
                     "fast_mode": FAST,
+                    "code": _code_fingerprint(),
                     "result": payload["result"],
                 }
                 _save_partial(partial)
@@ -1104,6 +1141,11 @@ def main() -> None:
             extra[name] = dict(cached["result"])
             extra[name]["backend"] = cached["backend"]
             extra[name]["cached_from"] = cached["captured"]
+            if cached.get("code") and cached["code"] != _code_fingerprint():
+                # The capture predates a source change (ADVICE r4 #2).
+                # Still the best evidence available, but say so: the
+                # number measured different code than HEAD.
+                extra[name]["cached_stale_code"] = True
             cached_sections.append(name)
             counts["cached"] += 1
         elif token in CPU_OK:
@@ -1149,25 +1191,88 @@ def main() -> None:
     extra["total_s"] = round(time.perf_counter() - t_start, 1)
 
     value, metric, unit = 0.0, "no_configs_selected", "writes/s"
+    headline_from = None
     for name, field, m, u in HEADLINE_ORDER:
         sec = extra.get(name)
         if isinstance(sec, dict) and field in sec:
-            value, metric, unit = sec[field], m, u
+            value, metric, unit, headline_from = sec[field], m, u, name
             break
     is_writes = unit == "writes/s" and metric != "no_configs_selected"
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": value,
-                "unit": unit,
-                "vs_baseline": round(value / NORTH_STAR_WRITES_PER_SEC, 5)
-                if is_writes
-                else None,
-                "extra": extra,
-            }
+    record = {
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "vs_baseline": round(value / NORTH_STAR_WRITES_PER_SEC, 5)
+        if is_writes
+        else None,
+        "extra": extra,
+    }
+
+    # Full record -> BENCH_detail.json + stderr; stdout gets ONLY a
+    # compact line, printed LAST.  The driver keeps a bounded tail of
+    # stdout: in r04 the all-sections-inline line outgrew that window
+    # and the record's beginning -- the headline itself -- was lost
+    # (BENCH_r04.json "parsed": null).  The compact line is unit-tested
+    # to stay under 1 KB even when every section reports.
+    try:
+        tmp = DETAIL_PATH + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(record, f, indent=1, sort_keys=True)
+        os.replace(tmp, DETAIL_PATH)
+    except OSError:
+        pass
+    print(json.dumps(record), file=sys.stderr)
+    record["extra"] = _compact_extra(extra, configs, headline_from)
+    print(json.dumps(record))
+
+
+def _compact_extra(extra: dict, configs: list, headline_from) -> dict:
+    """Small (<1 KB) summary of ``extra`` for the final stdout line.
+
+    Per section: ``[status, headline number]`` where status is one of
+    tpu / cached / cached-stale / cpu / cpu-fallback / skip / err.
+    Full per-section dicts live in BENCH_detail.json and on stderr.
+    """
+    sections: dict = {}
+    for token in configs:
+        name = SECTION_NAMES[token]
+        sec = extra.get(name)
+        if not isinstance(sec, dict):
+            continue
+        if "skipped" in sec:
+            sections[name] = "skip"
+            continue
+        if "error" in sec:
+            sections[name] = "err"
+            continue
+        backend = str(sec.get("backend", "?"))
+        if "cached_from" in sec:
+            status = "cached-stale" if sec.get("cached_stale_code") else "cached"
+        elif backend.startswith("cpu ("):
+            status = "cpu-fallback"
+        else:
+            status = backend
+        num = next(
+            (
+                round(v, 2)
+                for k, v in sec.items()
+                if k.endswith("_per_sec") and isinstance(v, (int, float))
+            ),
+            None,
         )
-    )
+        sections[name] = [status, num] if num is not None else status
+    out = {
+        "backend": extra.get("backend"),
+        "jax": extra.get("jax"),
+        "devices": extra.get("devices"),
+        "fast_mode": extra.get("fast_mode"),
+        "sections": sections,
+        "total_s": extra.get("total_s"),
+        "detail": "BENCH_detail.json",
+    }
+    if headline_from:
+        out["headline_from"] = headline_from
+    return out
 
 
 if __name__ == "__main__":

@@ -1,4 +1,4 @@
-"""The bench orchestrator's evidence policy (VERDICT r3 item 1).
+"""The bench orchestrator's evidence policy (VERDICT r3 item 1, r4 item 2).
 
 bench.py is the round's measurement record; its cache/fallback state
 machine decides what the driver's end-of-round run reports when the
@@ -10,7 +10,12 @@ subprocesses and pin the policy:
 - a FAST-mode capture never stands in for a full-matrix record;
 - a genuine section error is reported, never masked by a stale cache;
 - a hung child (tunnel died mid-run) falls back to cache and marks
-  health unknown so the next section re-probes.
+  health unknown so the next section re-probes;
+- the final stdout line is COMPACT (<1 KB) so the driver's bounded
+  stdout tail can never truncate away the headline (r04's failure),
+  with the full record in BENCH_detail.json and on stderr;
+- cached captures carry a code fingerprint; reuse after a source change
+  is flagged `cached_stale_code` (ADVICE r4 #2).
 """
 
 from __future__ import annotations
@@ -32,15 +37,20 @@ def bench(tmp_path, monkeypatch):
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     monkeypatch.setattr(mod, "PARTIAL_PATH", str(tmp_path / "partial.json"))
+    monkeypatch.setattr(mod, "DETAIL_PATH", str(tmp_path / "detail.json"))
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
     monkeypatch.setenv("BENCH_CONFIGS", "tally")
     return mod
 
 
-def _run_main(mod, capsys) -> dict:
+def _run_main(mod, capsys):
+    """Run main(); return (compact stdout record, full detail record)."""
     mod.main()
     line = capsys.readouterr().out.strip().splitlines()[-1]
-    return json.loads(line)
+    compact = json.loads(line)
+    with open(mod.DETAIL_PATH) as f:
+        detail = json.load(f)
+    return compact, detail
 
 
 def test_live_tpu_result_persists_and_wins(bench, monkeypatch, capsys):
@@ -56,11 +66,14 @@ def test_live_tpu_result_persists_and_wins(bench, monkeypatch, capsys):
             "result": {"tallies_per_sec": 123.0},
         },
     )
-    out = _run_main(bench, capsys)
-    assert out["extra"]["backend"] == "tpu"
-    assert out["extra"]["revoke_tally_256"]["tallies_per_sec"] == 123.0
+    compact, detail = _run_main(bench, capsys)
+    assert compact["extra"]["backend"] == "tpu"
+    assert compact["extra"]["sections"]["revoke_tally_256"] == ["tpu", 123.0]
+    assert detail["extra"]["revoke_tally_256"]["tallies_per_sec"] == 123.0
     saved = bench._load_partial()
     assert saved["sections"]["revoke_tally_256"]["backend"] == "tpu"
+    # Captures are stamped with the code fingerprint for staleness checks.
+    assert saved["sections"]["revoke_tally_256"]["code"] == bench._code_fingerprint()
 
 
 def test_dead_tunnel_reuses_cached_capture_labeled(bench, monkeypatch, capsys):
@@ -73,6 +86,7 @@ def test_dead_tunnel_reuses_cached_capture_labeled(bench, monkeypatch, capsys):
                     "devices": ["TPU_0"],
                     "captured": "2026-07-30T12:00:00Z",
                     "fast_mode": False,
+                    "code": bench._code_fingerprint(),
                     "result": {"tallies_per_sec": 999.0},
                 }
             }
@@ -84,12 +98,52 @@ def test_dead_tunnel_reuses_cached_capture_labeled(bench, monkeypatch, capsys):
         lambda *a, **k: pytest.fail("no child may run on a dead tunnel "
                                     "when a cache exists"),
     )
-    out = _run_main(bench, capsys)
-    sec = out["extra"]["revoke_tally_256"]
+    compact, detail = _run_main(bench, capsys)
+    sec = detail["extra"]["revoke_tally_256"]
     assert sec["tallies_per_sec"] == 999.0
     assert sec["cached_from"] == "2026-07-30T12:00:00Z"
-    assert out["extra"]["backend"] == "tpu"
-    assert out["extra"]["cached_sections"] == ["revoke_tally_256"]
+    assert "cached_stale_code" not in sec  # fingerprint matches HEAD
+    assert detail["extra"]["backend"] == "tpu"
+    assert detail["extra"]["cached_sections"] == ["revoke_tally_256"]
+    assert compact["extra"]["sections"]["revoke_tally_256"] == ["cached", 999.0]
+
+
+def test_cached_capture_from_older_code_is_flagged(bench, monkeypatch, capsys):
+    bench._save_partial(
+        {
+            "sections": {
+                "revoke_tally_256": {
+                    "backend": "tpu",
+                    "jax": "x",
+                    "devices": ["TPU_0"],
+                    "captured": "2026-07-30T12:00:00Z",
+                    "fast_mode": False,
+                    "code": "deadbeef0000",  # pre-change fingerprint
+                    "result": {"tallies_per_sec": 999.0},
+                }
+            }
+        }
+    )
+    monkeypatch.setattr(bench, "_probe_backend", lambda t: False)
+    monkeypatch.setattr(
+        bench,
+        "_run_child",
+        lambda token, t, force_cpu: {
+            "section": "revoke_tally_256",
+            "backend": "cpu",
+            "devices": ["CPU_0"],
+            "jax": "x",
+            "result": {"tallies_per_sec": 7.0},
+        },
+    )
+    compact, detail = _run_main(bench, capsys)
+    sec = detail["extra"]["revoke_tally_256"]
+    # Still the best evidence available — reused, but honestly labeled.
+    assert sec["tallies_per_sec"] == 999.0
+    assert sec["cached_stale_code"] is True
+    assert compact["extra"]["sections"]["revoke_tally_256"] == [
+        "cached-stale", 999.0,
+    ]
 
 
 def test_fast_mode_capture_rejected_for_full_run(bench, monkeypatch, capsys):
@@ -121,11 +175,14 @@ def test_fast_mode_capture_rejected_for_full_run(bench, monkeypatch, capsys):
             "result": {"tallies_per_sec": 7.0},
         },
     )
-    out = _run_main(bench, capsys)
-    sec = out["extra"]["revoke_tally_256"]
+    compact, detail = _run_main(bench, capsys)
+    sec = detail["extra"]["revoke_tally_256"]
     assert sec["tallies_per_sec"] == 7.0
     assert "cached_from" not in sec
-    assert "cpu" in out["extra"]["backend"]
+    assert "cpu" in detail["extra"]["backend"]
+    assert compact["extra"]["sections"]["revoke_tally_256"] == [
+        "cpu-fallback", 7.0,
+    ]
 
 
 def test_section_error_not_masked_by_cache(bench, monkeypatch, capsys):
@@ -155,8 +212,9 @@ def test_section_error_not_masked_by_cache(bench, monkeypatch, capsys):
             "result": {"error": "AssertionError: kernel wrong"},
         },
     )
-    out = _run_main(bench, capsys)
-    assert "error" in out["extra"]["revoke_tally_256"]
+    compact, detail = _run_main(bench, capsys)
+    assert "error" in detail["extra"]["revoke_tally_256"]
+    assert compact["extra"]["sections"]["revoke_tally_256"] == "err"
 
 
 def test_hung_child_falls_back_to_cache(bench, monkeypatch, capsys):
@@ -178,7 +236,65 @@ def test_hung_child_falls_back_to_cache(bench, monkeypatch, capsys):
     monkeypatch.setattr(
         bench, "_run_child", lambda token, t, force_cpu: None  # hang/kill
     )
-    out = _run_main(bench, capsys)
-    sec = out["extra"]["revoke_tally_256"]
+    compact, detail = _run_main(bench, capsys)
+    sec = detail["extra"]["revoke_tally_256"]
     assert sec["tallies_per_sec"] == 999.0
     assert sec["cached_from"] == "2026-07-30T12:00:00Z"
+    assert compact["extra"]["sections"]["revoke_tally_256"] == ["cached", 999.0]
+
+
+def test_final_stdout_line_stays_small(bench, monkeypatch, capsys):
+    """The driver keeps a bounded stdout tail; the headline line must
+    never outgrow it.  Worst realistic cases: the full 16-section matrix
+    with every section skipped (r04's shape), and the full matrix with
+    every section reporting a number.
+    """
+    all_tokens = ",".join(bench.SECTION_NAMES)
+    monkeypatch.setenv("BENCH_CONFIGS", all_tokens)
+
+    # Case 1: dead tunnel, empty cache, nothing CPU_OK → all skip/cpu.
+    monkeypatch.setattr(bench, "_probe_backend", lambda t: False)
+    monkeypatch.setattr(
+        bench,
+        "_run_child",
+        lambda token, t, force_cpu: {
+            "section": bench.SECTION_NAMES[token],
+            "backend": "cpu",
+            "devices": ["CPU_0"],
+            "jax": "0.9.0",
+            "result": {"writes_per_sec": 7.28, "write_p50_s": 2.03},
+        },
+    )
+    bench.main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert len(line.encode()) < 1024, f"{len(line)}B: {line[:200]}"
+    parsed = json.loads(line)
+    assert parsed["metric"]  # headline survived
+    assert parsed["extra"]["detail"] == "BENCH_detail.json"
+
+    # Case 2: live TPU, every section reports.
+    monkeypatch.setattr(bench, "_probe_backend", lambda t: True)
+    monkeypatch.setattr(
+        bench,
+        "_run_child",
+        lambda token, t, force_cpu: {
+            "section": bench.SECTION_NAMES[token],
+            "backend": "tpu",
+            "devices": ["TPU_0"],
+            "jax": "0.9.0",
+            "result": {
+                "writes_per_sec": 123456.78,
+                "write_p50_s": 0.001,
+                "verifies_device": 10**9,
+            },
+        },
+    )
+    bench.main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    assert len(line.encode()) < 1536, f"{len(line)}B"
+    parsed = json.loads(line)
+    assert parsed["extra"]["backend"] == "tpu"
+    # Full record retrievable from the detail file.
+    with open(bench.DETAIL_PATH) as f:
+        detail = json.load(f)
+    assert detail["extra"]["cluster_64_batched"]["verifies_device"] == 10**9
