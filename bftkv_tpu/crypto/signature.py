@@ -50,20 +50,19 @@ def serialize_entries(entries: list[tuple[int, bytes]]) -> bytes:
 def parse_entries(data: bytes | None) -> list[tuple[int, bytes]]:
     if not data:
         return []
-    r = io.BytesIO(data)
     out: list[tuple[int, bytes]] = []
-    while True:
-        hdr = r.read(8)
-        if len(hdr) == 0:
-            return out
-        if len(hdr) < 8:
+    off, n = 0, len(data)
+    while off < n:
+        if off + 16 > n:  # torn id or torn chunk header
             raise ERR_INVALID_SIGNATURE
-        signer_id = struct.unpack(">Q", hdr)[0]
-        try:
-            sig = read_chunk(r) or b""
-        except Exception:
-            raise ERR_INVALID_SIGNATURE from None
-        out.append((signer_id, sig))
+        signer_id = int.from_bytes(data[off : off + 8], "big")
+        length = int.from_bytes(data[off + 8 : off + 16], "big")
+        off += 16
+        if length > n - off:
+            raise ERR_INVALID_SIGNATURE
+        out.append((signer_id, data[off : off + length]))
+        off += length
+    return out
 
 
 class Signer:
@@ -91,21 +90,19 @@ class Signer:
         ``issue`` is the one-item form."""
         from bftkv_tpu.ops import dispatch
 
-        if certmod.is_ec(self.key):
+        # Both algorithms ride the dispatcher when one is installed —
+        # i.e. this process explicitly claimed a chip (--dispatch) —
+        # so concurrent handlers' batches coalesce into shared device
+        # launches (CRT modexp for RSA, nonce base-mults for EC) and
+        # stop serializing on the GIL.  Signing stays host-side
+        # otherwise: a sidecar-mode daemon must never initialize the
+        # accelerator the sidecar owns.
+        if (d := dispatch.get_signer()) is not None:
+            sigs = d.submit([(tbs, self.key) for tbs in tbs_list])
+        elif certmod.is_ec(self.key):
             from bftkv_tpu.crypto import ecdsa as _ecdsa
 
-            # Device batching (ops.ec base-mults for the nonces) only
-            # when a sign dispatcher was installed — i.e. this process
-            # explicitly claimed a chip (--dispatch).  Signing stays
-            # host-side otherwise, exactly like the RSA branch; a
-            # sidecar-mode daemon must never initialize the accelerator
-            # the sidecar owns.
-            if dispatch.get_signer() is not None:
-                sigs = _ecdsa.sign_batch(tbs_list, self.key)
-            else:
-                sigs = [_ecdsa.sign(tbs, self.key) for tbs in tbs_list]
-        elif (d := dispatch.get_signer()) is not None:
-            sigs = d.submit([(tbs, self.key) for tbs in tbs_list])
+            sigs = [_ecdsa.sign(tbs, self.key) for tbs in tbs_list]
         else:
             sigs = [rsa.sign(tbs, self.key) for tbs in tbs_list]
         cert_bytes = self.cert.serialize() if include_cert else None
@@ -181,12 +178,21 @@ class CollectiveSignature:
         results: list[Exception | type | None] = [None] * len(jobs)
         items: list[tuple[bytes, bytes, rsa.PublicKey]] = []
         spans: list[tuple[int, list[certmod.Certificate]]] = []
+        # One batch's jobs typically embed the SAME merged cert set in
+        # every item; parse each distinct byte string once per call.
+        cert_cache: dict[bytes, dict[int, certmod.Certificate]] = {}
         for j, (tbss, ss) in enumerate(jobs):
             certs: list[certmod.Certificate] = []
             start = len(items)
             try:
                 entries = parse_entries(ss.data if ss else None)
-                embedded = _embedded_certs(ss) if ss else {}
+                if ss is None or not ss.cert:
+                    embedded = {}
+                else:
+                    embedded = cert_cache.get(ss.cert)
+                    if embedded is None:
+                        embedded = _embedded_certs(ss)
+                        cert_cache[ss.cert] = embedded
                 for signer_id, sig in entries:
                     c = _resolve_cert(signer_id, keyring, embedded)
                     if c is None:
@@ -289,16 +295,35 @@ def verify_with_certificate(
     raise ERR_INVALID_SIGNATURE
 
 
-def issuer(pkt: SignaturePacket | None, keyring) -> certmod.Certificate:
-    """The (first) signer's certificate, from keyring or embedded."""
+def issuer(
+    pkt: SignaturePacket | None, keyring, extra: dict | None = None
+) -> certmod.Certificate:
+    """The (first) signer's certificate, from keyring or embedded.
+
+    Embedded certs parse LAZILY: on the hot server paths the signer is
+    nearly always in the keyring, and the per-item cert parse was a
+    top handler cost at batch shapes.
+
+    ``extra`` is a frame-level id→cert map (batch handlers harvest the
+    carrier item's embedded cert once per frame); it backstops items
+    whose own packet carries no cert because the client embedded the
+    writer cert on the first batch item only."""
     if pkt is None or not pkt.data:
         raise ERR_CERTIFICATE_NOT_FOUND
+    entries = parse_entries(pkt.data)
+    if keyring is not None:
+        for sid, _ in entries:
+            c = keyring.get(sid)
+            if c is not None:
+                return c
     try:
         embedded = _embedded_certs(pkt)
     except Exception:
         embedded = {}
-    for sid, _ in parse_entries(pkt.data):
-        c = _resolve_cert(sid, keyring, embedded)
+    for sid, _ in entries:
+        c = embedded.get(sid)
+        if c is None and extra is not None:
+            c = extra.get(sid)
         if c is not None:
             return c
     raise ERR_CERTIFICATE_NOT_FOUND

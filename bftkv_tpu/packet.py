@@ -102,6 +102,59 @@ def _read_signature(r: io.BytesIO) -> SignaturePacket | None:
     )
 
 
+# -- offset-based readers (hot path) ---------------------------------------
+# The stream readers above stay for callers that genuinely consume a
+# stream (keyring files, multi-record buffers).  The per-packet parsers
+# below run thousands of times per batch handler call; a BytesIO per
+# chunk was the top Python cost in the handler profile, so they walk
+# (bytes, offset) instead.  Semantics are pinned equal to the stream
+# readers by tests/test_packet_fuzz.py.
+
+
+def _chunk_at(b: bytes, off: int) -> tuple[bytes | None, int]:
+    n = len(b)
+    if off == n:
+        raise EOFError
+    if off + 8 > n:
+        raise ERR_MALFORMED_REQUEST
+    length = int.from_bytes(b[off : off + 8], "big")
+    off += 8
+    if length == 0:
+        return None, off
+    if length > n - off:
+        raise ERR_MALFORMED_REQUEST
+    return b[off : off + length], off + length
+
+
+def _u64_at(b: bytes, off: int) -> tuple[int, int]:
+    n = len(b)
+    if off == n:
+        raise EOFError
+    if off + 8 > n:
+        raise ERR_MALFORMED_REQUEST
+    return int.from_bytes(b[off : off + 8], "big"), off + 8
+
+
+def _signature_at(b: bytes, off: int) -> tuple[SignaturePacket | None, int]:
+    n = len(b)
+    if off == n:
+        raise EOFError
+    if off + 6 > n:
+        raise ERR_MALFORMED_REQUEST
+    typ, version, completed = struct.unpack_from(">BI?", b, off)
+    data, off = _chunk_at(b, off + 6)
+    cert, off = _chunk_at(b, off)
+    if typ == SIGNATURE_TYPE_NIL:
+        return None, off
+    return (
+        SignaturePacket(
+            type=typ, version=version, completed=completed,
+            data=data, cert=cert,
+        ),
+        off,
+    )
+
+
 def serialize(
     variable: bytes,
     value: bytes | None = None,
@@ -163,18 +216,17 @@ def parse(pkt: bytes) -> Packet:
     """Parse a packet, tolerating omitted *trailing* fields. EOF before the
     first field is a malformed request — the reference only forgives EOF
     after ``variable`` (reference: packet/packet.go:62-115)."""
-    r = io.BytesIO(pkt)
     out = Packet()
     try:
-        out.variable = read_chunk(r)
+        out.variable, off = _chunk_at(pkt, 0)
     except EOFError:
         raise ERR_MALFORMED_REQUEST from None
     try:
-        out.value = read_chunk(r)
-        out.t = _read_u64(r)
-        out.sig = _read_signature(r)
-        out.ss = _read_signature(r)
-        out.auth = read_chunk(r)
+        out.value, off = _chunk_at(pkt, off)
+        out.t, off = _u64_at(pkt, off)
+        out.sig, off = _signature_at(pkt, off)
+        out.ss, off = _signature_at(pkt, off)
+        out.auth, off = _chunk_at(pkt, off)
     except EOFError:
         pass
     return out
@@ -182,19 +234,15 @@ def parse(pkt: bytes) -> Packet:
 
 def _tbs_offset(pkt: bytes) -> int:
     """Offset just past ``t`` (reference: packet/packet.go:142-154)."""
-    r = io.BytesIO(pkt)
     try:
+        off = 0
         for _ in range(2):  # variable, value
-            length = _read_u64(r)
-            if length > len(pkt) - r.tell():
-                raise ERR_MALFORMED_REQUEST
-            r.seek(length, io.SEEK_CUR)
+            _, off = _chunk_at(pkt, off)
+        off += 8  # timestamp
+        if off > len(pkt):
+            raise EOFError
     except EOFError:
         raise ERR_MALFORMED_REQUEST from None
-    r.seek(8, io.SEEK_CUR)  # timestamp
-    off = r.tell()
-    if off > len(pkt):
-        raise ERR_MALFORMED_REQUEST
     return off
 
 
@@ -207,21 +255,16 @@ def tbss(pkt: bytes) -> bytes:
     """Bytes covered by the collective signature
     (reference: packet/packet.go:170-190)."""
     off = _tbs_offset(pkt)
-    r = io.BytesIO(pkt)
-    r.seek(off)
     try:
-        _read_signature(r)
+        _sig, end = _signature_at(pkt, off)
     except EOFError:
         raise ERR_MALFORMED_REQUEST from None
-    end = r.tell()
-    if end > len(pkt):
-        raise ERR_MALFORMED_REQUEST
     return pkt[:end]
 
 
 def parse_signature(pkt: bytes) -> SignaturePacket | None:
     try:
-        return _read_signature(io.BytesIO(pkt))
+        return _signature_at(pkt, 0)[0]
     except EOFError:
         raise ERR_MALFORMED_REQUEST from None
 
@@ -267,20 +310,20 @@ def serialize_list(items: list[bytes]) -> bytes:
 
 
 def parse_list(data: bytes) -> list[bytes]:
-    r = io.BytesIO(data)
-    hdr = r.read(4)
-    if len(hdr) < 4:
+    if len(data) < 4:
         raise ERR_MALFORMED_REQUEST
-    (count,) = struct.unpack(">I", hdr)
+    count = int.from_bytes(data[:4], "big")
     # Each item needs at least an 8-byte length header after the count.
     if count > (len(data) - 4) // 8:
         raise ERR_MALFORMED_REQUEST
     out: list[bytes] = []
+    off = 4
     for _ in range(count):
         try:
-            out.append(read_chunk(r) or b"")
+            c, off = _chunk_at(data, off)
         except EOFError:
             raise ERR_MALFORMED_REQUEST from None
+        out.append(c or b"")
     return out
 
 
@@ -327,3 +370,132 @@ def read_bigint(r: io.BytesIO) -> int:
     except EOFError:
         raise ERR_MALFORMED_REQUEST from None
     return int.from_bytes(c or b"", "big")
+
+
+# -- optional C codec -------------------------------------------------------
+# The per-packet codec is the top Python cost in the batch handlers
+# (docs/PERFORMANCE.md "Handler Python ceiling").  native/packetcodec.c
+# implements the identical grammar; the pure-Python functions above
+# stay as the fallback AND as the semantics oracle the fuzz tests
+# compare against (tests/test_packet_fuzz.py).  Disable with
+# BFTKV_NATIVE_CODEC=off.
+
+_py_parse = parse
+_py_tbs = tbs
+_py_tbss = tbss
+_py_parse_signature = parse_signature
+_py_parse_list = parse_list
+_py_serialize = serialize
+_py_serialize_signature = serialize_signature
+
+
+def _load_native_codec():
+    import importlib.util
+    import os
+    import subprocess
+    import sysconfig
+
+    if os.environ.get("BFTKV_NATIVE_CODEC", "auto") == "off":
+        return None
+    nd = os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "native")
+    )
+    try:
+        # Resolve headers and the ABI tag from the RUNNING interpreter
+        # (a PATH python3 may be a different version — its .so would be
+        # ABI-incompatible), and serialize concurrent builders (N
+        # daemons starting at once must not write the same .so).
+        inc = sysconfig.get_paths()["include"]
+        suffix = sysconfig.get_config_var("EXT_SUFFIX")
+        so_path = os.path.join(nd, f"_packetcodec{suffix}")
+        src = os.path.join(nd, "packetcodec.c")
+        if not os.path.exists(so_path) or (
+            os.path.getmtime(so_path) < os.path.getmtime(src)
+        ):
+            import fcntl
+
+            with open(os.path.join(nd, ".codec.lock"), "w") as lk:
+                fcntl.flock(lk, fcntl.LOCK_EX)
+                subprocess.run(
+                    [
+                        "make", "-s", "codec",
+                        f"PY_INC={inc}", f"EXT_SUFFIX={suffix}",
+                    ],
+                    cwd=nd, check=True, capture_output=True,
+                )
+        spec = importlib.util.spec_from_file_location(
+            "bftkv_tpu._packetcodec", so_path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        mod.set_malformed(ERR_MALFORMED_REQUEST)
+        return mod
+    except Exception:
+        return None
+
+
+_C = _load_native_codec()
+
+if _C is not None:
+
+    def _sig_from_tuple(t):
+        if t is None:
+            return None
+        return SignaturePacket(
+            type=t[0], version=t[1], completed=t[2], data=t[3], cert=t[4]
+        )
+
+    def _sig_to_tuple(s):
+        if s is None:
+            return None
+        if not 0 <= s.type <= 0xFF:
+            raise ValueError(
+                f"signature type {s.type} does not fit one byte"
+            )
+        return (s.type, s.version, s.completed, s.data, s.cert)
+
+    def parse(pkt: bytes) -> Packet:  # noqa: F811
+        variable, value, t, sig, ss, auth = _C.parse(pkt)
+        return Packet(
+            variable=variable,
+            value=value,
+            t=t,
+            sig=_sig_from_tuple(sig),
+            ss=_sig_from_tuple(ss),
+            auth=auth,
+        )
+
+    def tbs(pkt: bytes) -> bytes:  # noqa: F811
+        return pkt[: _C.tbs_offset(pkt)]
+
+    def tbss(pkt: bytes) -> bytes:  # noqa: F811
+        return pkt[: _C.tbss_end(pkt)]
+
+    def parse_signature(pkt: bytes) -> SignaturePacket | None:  # noqa: F811
+        return _sig_from_tuple(_C.parse_signature(pkt))
+
+    def parse_list(data: bytes) -> list[bytes]:  # noqa: F811
+        return _C.parse_list(data)
+
+    def serialize(  # noqa: F811
+        variable: bytes,
+        value: bytes | None = None,
+        t: int | None = None,
+        sig: SignaturePacket | None = None,
+        ss: SignaturePacket | None = None,
+        auth: bytes | None = None,
+        *,
+        nfields: int | None = None,
+    ) -> bytes:
+        return _C.serialize(
+            variable,
+            value,
+            t or 0,
+            _sig_to_tuple(sig) if nfields is None or nfields >= 4 else None,
+            _sig_to_tuple(ss) if nfields is None or nfields >= 5 else None,
+            auth,
+            6 if nfields is None else nfields,
+        )
+
+    def serialize_signature(sig: SignaturePacket | None) -> bytes:  # noqa: F811
+        return _C.serialize_signature(_sig_to_tuple(sig))

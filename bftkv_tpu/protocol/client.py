@@ -343,9 +343,16 @@ class Client(Protocol):
                 for i in pending
             ]
             with metrics.timer("client.write_many.phase_self_sign"):
-                sigs = dict(
-                    zip(pending, self.crypt.signer.issue_many(tbs_list))
+                # The writer cert rides the FIRST item only; servers
+                # resolve embedded certs frame-wide in _batch_sign, so
+                # B−1 cert copies come off the wire and off the
+                # server's parse path.
+                pkts = self.crypt.signer.issue_many(
+                    tbs_list, include_cert=False
                 )
+                if pkts:
+                    pkts[0].cert = self.crypt.signer.cert.serialize()
+                sigs = dict(zip(pending, pkts))
             reqs = [
                 pkt.serialize(items[i][0], items[i][1], ts[i], sigs[i], proof)
                 for i in pending

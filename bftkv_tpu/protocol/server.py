@@ -43,6 +43,7 @@ from bftkv_tpu.crypto import signature as sigmod
 from bftkv_tpu.errors import (
     ERR_AUTHENTICATION_FAILURE,
     ERR_BAD_TIMESTAMP,
+    ERR_CERTIFICATE_NOT_FOUND,
     ERR_EQUIVOCATION,
     ERR_EXIST,
     ERR_INVALID_QUORUM_CERTIFICATE,
@@ -247,6 +248,17 @@ class Server(Protocol):
         issuer = sigmod.issuer(sig, self.crypt.keyring)
         tbs = pkt.tbs(req)
         sigmod.verify_with_certificate(tbs, sig, issuer)
+        # The presented cert may carry a richer quorum certificate
+        # than this replica's keyring copy; check against a transient
+        # enriched view (never persisted — see _present).
+        if sig.cert:
+            try:
+                for c in certmod.parse(sig.cert):
+                    if c.id == issuer.id:
+                        issuer = self._present(c)
+                        break
+            except Exception:
+                pass
         self._check_quorum_certificate(issuer)
 
         proof = self._sign_storage_checks(variable, val, t, sig, ss)
@@ -263,16 +275,62 @@ class Server(Protocol):
         return res
 
     def _check_quorum_certificate(self, issuer) -> None:
-        """The writer's certificate must be signed by a CERT-quorum
-        threshold (reference: server.go:211-214)."""
+        """The writer's certificate must carry VALID signatures from a
+        CERT-quorum threshold (reference: server.go:211-214).
+
+        Each counted signature is cryptographically verified (memoized
+        per (signer, sig-bytes) on the cert object): embedded certs
+        presented by writers merge into the keyring copy
+        (:meth:`_merge_embedded`, the reference's merge-on-import,
+        crypto_pgp.go:186-204), so an id-only count would let a writer
+        claim arbitrary signer ids and mint a quorum certificate."""
         q = self.qs.choose_quorum(qm.AUTH | qm.CERT)
-        signer_nodes = [
-            c
-            for sid in issuer.signers()
-            if (c := self.crypt.keyring.get(sid)) is not None
-        ]
+        cache = issuer.__dict__.setdefault("_qcert_ok", {})
+        signer_nodes = []
+        for sid, sig_bytes in list(issuer.signatures.items()):
+            c = self.crypt.keyring.get(sid)
+            if c is None:
+                continue
+            ok = cache.get((sid, sig_bytes))
+            if ok is None:
+                ok = certmod.verify_detached(issuer.tbs(), sig_bytes, c)
+                cache[(sid, sig_bytes)] = ok
+            if ok:
+                signer_nodes.append(c)
         if not q.is_threshold(signer_nodes):
             raise ERR_INVALID_QUORUM_CERTIFICATE
+
+    def _present(self, cert):
+        """TRANSIENT view of a presented certificate: the keyring copy
+        enriched with the presented signature set, never persisted.
+
+        A writer whose quorum certificate was accumulated across
+        replicas presents the rich copy; this replica's sparse keyring
+        copy must not shadow it (the reference converges rings by
+        merge-on-import, crypto_pgp.go:186-204).  But persisting the
+        merge would be unsound the other way: the trust GRAPH derives
+        edges from keyring signature sets, so a client presenting a
+        cert copy carrying extra *valid* third-party certifications
+        (public data) would silently add edges to this replica's graph
+        and reshape its quorums.  Hence: enrich a throwaway clone for
+        the signature-count check; the keyring and graph keep only
+        ring-sourced edges.  Every counted signature is still verified
+        cryptographically (:meth:`_check_quorum_certificate`)."""
+        have = self.crypt.keyring.get(cert.id)
+        if have is None:
+            return cert
+        if all(sid in have.signatures for sid in cert.signatures):
+            return have  # nothing new: keep the memoized keyring copy
+        rich = certmod.Certificate(
+            n=have.n, e=have.e, name=have.name, address=have.address,
+            uid=have.uid, alg=have.alg, point=have.point,
+            signatures=dict(have.signatures),
+        )
+        try:
+            rich.merge(cert)
+        except Exception:
+            return have
+        return rich
 
     def _sign_storage_checks(self, variable, val, t, sig, ss):
         """The per-variable part of ``sign``: TPA proof, write-once,
@@ -338,11 +396,19 @@ class Server(Protocol):
         metrics.incr("server.write.ok")
         return None
 
-    def _write_storage_checks(self, variable, val, t, sig, ss, req) -> bytes:
+    def _write_storage_checks(
+        self, variable, val, t, sig, ss, req, frame_embedded=None
+    ) -> bytes:
         """The per-variable part of ``write``: write-once, timestamp,
         equivocation, and TOFU checks against the stored version
         (reference: server.go:314-345).  Returns the bytes to persist
-        (the request, with inherited auth params folded in)."""
+        (the request, with inherited auth params folded in).
+
+        ``frame_embedded`` (id→cert) backstops TOFU issuer resolution
+        for batch items whose sig carries no cert of its own (the
+        client embeds the writer cert on the first item only) — and is
+        folded back into the PERSISTED record, which later overwrites
+        must resolve standalone (the frame is gone by then)."""
         rdata = None
         try:
             rdata = self.storage.read(variable, 0)
@@ -350,6 +416,20 @@ class Server(Protocol):
             pass
 
         out = req
+        if not sig.cert and frame_embedded:
+            # Mid-join writer, non-carrier item: restore the cert the
+            # single-item path would have persisted, so the stored
+            # record stays issuer-resolvable on its own.
+            for sid, _ in sigmod.parse_entries(sig.data):
+                if self.crypt.keyring.get(sid) is not None:
+                    break
+                fe = frame_embedded.get(sid)
+                if fe is not None:
+                    sig.cert = fe.serialize()
+                    out = pkt.serialize(
+                        variable, val, t, sig, ss, pkt.parse(req).auth
+                    )
+                    break
         if rdata is not None:
             rp = pkt.parse(rdata)
             if rp.t == MAX_UINT64:
@@ -365,8 +445,8 @@ class Server(Protocol):
 
             # TOFU: the new issuer must match the previous issuer's id
             # or uid (reference: server.go:329-337).
-            new_issuer = sigmod.issuer(sig, self.crypt.keyring)
-            prev_issuer = sigmod.issuer(rp.sig, self.crypt.keyring)
+            new_issuer = sigmod.issuer(sig, self.crypt.keyring, frame_embedded)
+            prev_issuer = sigmod.issuer(rp.sig, self.crypt.keyring, frame_embedded)
             if (
                 prev_issuer.id != new_issuer.id
                 and prev_issuer.uid != new_issuer.uid
@@ -426,17 +506,23 @@ class Server(Protocol):
     AUTH_IDLE_TTL = 3600.0
     AUTH_ATTEMPTS_MAX = 65536
 
+    def _spill_attempts_locked(self, var: bytes, attempts: int) -> None:
+        """Fold a retired/orphaned AuthServer's brute-force counter into
+        the LRU-capped ``_auth_attempts`` spill map (never decreasing);
+        caller holds ``_auth_lock``."""
+        if attempts > self._auth_attempts.get(var, 0):
+            self._auth_attempts[var] = attempts
+            self._auth_attempts.move_to_end(var)
+            while len(self._auth_attempts) > self.AUTH_ATTEMPTS_MAX:
+                self._auth_attempts.popitem(last=False)
+
     def _auth_evict_locked(self, now: float) -> None:
         """Evict idle/overflow AuthServers, preserving their attempt
         counters; caller holds ``_auth_lock``."""
 
         def retire(var: bytes, srv) -> None:
             self._auth_used.pop(var, None)
-            if srv.attempts:
-                self._auth_attempts[var] = srv.attempts
-                self._auth_attempts.move_to_end(var)
-                if len(self._auth_attempts) > self.AUTH_ATTEMPTS_MAX:
-                    self._auth_attempts.popitem(last=False)
+            self._spill_attempts_locked(var, srv.attempts)
 
         for var in [
             v
@@ -502,11 +588,36 @@ class Server(Protocol):
                 getattr(peer or sender, "name", "?"),
             )
             raise
+        finally:
+            # ``a`` was used outside the lock; a concurrent eviction may
+            # have retired it mid-handshake, in which case any attempt
+            # increments made here would vanish (ADVICE r4 #1).  Fold
+            # them back into whatever now owns the variable's counter.
+            self._auth_fold_attempts(variable, a)
         if done:
-            a.reset_attempts()  # successful login clears the penalty
+            # Successful login clears the penalty — on the handler's
+            # instance AND on whatever the map holds now (they can
+            # differ after a concurrent eviction + re-create).
+            a.reset_attempts()
             with self._auth_lock:
+                cur = self._auth.get(variable)
+                if cur is not None:
+                    cur.reset_attempts()
                 self._auth_attempts.pop(variable, None)
         return res
+
+    def _auth_fold_attempts(self, variable: bytes, a) -> None:
+        """Carry ``a``'s brute-force counter forward if ``a`` is no
+        longer the map's instance for ``variable`` (evicted or replaced
+        while an in-flight handler held it outside ``_auth_lock``)."""
+        with self._auth_lock:
+            cur = self._auth.get(variable)
+            if cur is a:
+                return
+            if cur is not None:
+                cur.attempts = max(cur.attempts, a.attempts)
+            else:
+                self._spill_attempts_locked(variable, a.attempts)
 
     # -- enrollment (reference: server.go:450-514) ------------------------
 
@@ -620,23 +731,61 @@ class Server(Protocol):
         parsed: list[tuple | None] = [None] * n  # (p, issuer, tbs)
         vitems: list = []
         vidx: list[int] = []
+
+        # Embedded certificates are FRAME-level: any item's embedded
+        # cert resolves signers of every item in the batch, and each
+        # distinct cert byte string parses exactly once.  (The client
+        # batch pipeline embeds its cert only on the first item; the
+        # profile showed per-item cert parsing was ~50% of the whole
+        # handler's Python time at batch 1024.)  Mirrors the response
+        # side's first-share-only embedding (ADVICE r3 low 4).
+        packets: list = [None] * n
+        frame_embedded: dict[int, object] = {}
+        seen_cert_bytes: set[bytes] = set()
         for i, r in enumerate(reqs):
             try:
                 p = pkt.parse(r)
-                variable, sig = p.variable or b"", p.sig
+                sig = p.sig
+                # Harvest embedded certs BEFORE the per-item policy
+                # checks: the cert-carrying item may itself be rejected
+                # (hidden prefix, malformed), and the client embeds the
+                # writer cert on the first item only — its rejection
+                # must not strip signer resolution from the whole frame.
+                if sig is not None and sig.cert:
+                    if sig.cert not in seen_cert_bytes:
+                        seen_cert_bytes.add(sig.cert)
+                        for c in certmod.parse(sig.cert):
+                            frame_embedded.setdefault(c.id, c)
                 if sig is None:
                     raise ERR_MALFORMED_REQUEST
-                if variable.startswith(HIDDEN_PREFIX):
+                if (p.variable or b"").startswith(HIDDEN_PREFIX):
                     raise ERR_PERMISSION_DENIED
-                issuer = sigmod.issuer(sig, self.crypt.keyring)
-                sig_bytes = next(
-                    (
-                        s
-                        for sid, s in sigmod.parse_entries(sig.data)
-                        if sid == issuer.id
-                    ),
-                    None,
-                )
+                packets[i] = p
+            except Exception as e:
+                results[i] = (_errstr(e), b"")
+        rich_cache: dict[int, object] = {}  # presented-cert views, per frame
+        for i, r in enumerate(reqs):
+            p = packets[i]
+            if p is None:
+                continue
+            try:
+                issuer = sig_bytes = None
+                for sid, sb in sigmod.parse_entries(p.sig.data):
+                    c = self.crypt.keyring.get(sid)
+                    fe = frame_embedded.get(sid)
+                    if c is None:
+                        c = fe
+                    elif fe is not None:
+                        # Presented cert may carry a richer quorum
+                        # certificate; transient view (see _present).
+                        c = rich_cache.get(sid)
+                        if c is None:
+                            rich_cache[sid] = c = self._present(fe)
+                    if c is not None:
+                        issuer, sig_bytes = c, sb
+                        break
+                if issuer is None:
+                    raise ERR_CERTIFICATE_NOT_FOUND
                 if sig_bytes is None:
                     raise ERR_INVALID_SIGNATURE
                 tbs = pkt.tbs(r)
@@ -698,6 +847,13 @@ class Server(Protocol):
             except Exception as e:
                 results[i] = (_errstr(e), b"")
                 continue
+            # Keep stored records self-contained: a mid-join writer's
+            # cert rode the frame's carrier item only, but later
+            # overwrites resolve prev_issuer from THIS record alone —
+            # restore the embedded cert the single-item path would
+            # have persisted.  Keyring-resolvable issuers stay lean.
+            if not sig.cert and self.crypt.keyring.get(issuer.id) is None:
+                sig.cert = issuer.serialize()
             stored = pkt.serialize(variable, val, t, sig, None, proof)
             self.storage.write(variable, t, stored)
             tbss_list.append(pkt.tbss(r))
@@ -736,10 +892,21 @@ class Server(Protocol):
         parsed: list[tuple | None] = [None] * n
         jobs: list[tuple[bytes, object]] = []
         jidx: list[int] = []
+        # Frame-level embedded-cert harvest, as in _batch_sign: the
+        # writer cert rides the first item only, but TOFU issuer
+        # resolution in _write_storage_checks needs it for EVERY item
+        # of a mid-join writer's overwrite.
+        frame_embedded: dict[int, object] = {}
+        seen_cert_bytes: set[bytes] = set()
         for i, r in enumerate(reqs):
             try:
                 p = pkt.parse(r)
                 variable, sig, ss = p.variable or b"", p.sig, p.ss
+                if sig is not None and sig.cert:
+                    if sig.cert not in seen_cert_bytes:
+                        seen_cert_bytes.add(sig.cert)
+                        for c in certmod.parse(sig.cert):
+                            frame_embedded.setdefault(c.id, c)
                 if sig is None or ss is None:
                     raise ERR_MALFORMED_REQUEST
                 if variable.startswith(HIDDEN_PREFIX):
@@ -772,7 +939,9 @@ class Server(Protocol):
                 p.ss,
             )
             try:
-                out = self._write_storage_checks(variable, val, t, sig, ss, r)
+                out = self._write_storage_checks(
+                    variable, val, t, sig, ss, r, frame_embedded
+                )
             except Exception as e:
                 results[i] = (_errstr(e), b"")
                 continue

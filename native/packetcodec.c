@@ -1,0 +1,505 @@
+/* C packet codec for the bftkv_tpu wire format.
+ *
+ * The hot server handlers (batch sign/write: protocol/server.py) parse
+ * and re-serialize thousands of <x,v,t,sig,ss,auth> packets per call;
+ * the Python codec costs 6-12 us per operation, which caps a replica
+ * process at ~12k handler items/s (docs/PERFORMANCE.md "Handler Python
+ * ceiling").  This module implements the same grammar (byte-compatible
+ * with the reference codec, packet/packet.go:35-115) in C, loaded
+ * on demand by bftkv_tpu/packet.py with the pure-Python implementation
+ * kept as fallback and as the fuzz-tested semantics oracle.
+ *
+ * Grammar (all multi-byte integers big-endian):
+ *   chunk      = u64 length | length bytes      (length 0 -> None)
+ *   signature  = u8 type | u32 version | u8 completed |
+ *                chunk(data) | chunk(cert)      (type 0 -> None)
+ *   packet     = chunk(x) [chunk(v) [u64 t [sig [ss [chunk(auth)]]]]]
+ *   list       = u32 count | count * chunk
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+static PyObject *Malformed = NULL; /* ERR_MALFORMED_REQUEST class */
+
+static uint64_t
+rd_u64(const unsigned char *p)
+{
+    uint64_t v = 0;
+    for (int i = 0; i < 8; i++)
+        v = (v << 8) | p[i];
+    return v;
+}
+
+static uint32_t
+rd_u32(const unsigned char *p)
+{
+    uint32_t v = 0;
+    for (int i = 0; i < 4; i++)
+        v = (v << 8) | p[i];
+    return v;
+}
+
+static int
+raise_malformed(void)
+{
+    PyErr_SetNone(Malformed ? Malformed : PyExc_ValueError);
+    return -1;
+}
+
+/* -1 error (exception set), -2 clean EOF (no exception), 0 ok. */
+static int
+chunk_at(const unsigned char *b, Py_ssize_t n, Py_ssize_t *off,
+         PyObject **out)
+{
+    if (*off == n)
+        return -2;
+    if (*off + 8 > n)
+        return raise_malformed();
+    uint64_t ln = rd_u64(b + *off);
+    *off += 8;
+    if (ln == 0) {
+        Py_INCREF(Py_None);
+        *out = Py_None;
+        return 0;
+    }
+    if (ln > (uint64_t)(n - *off))
+        return raise_malformed();
+    *out = PyBytes_FromStringAndSize((const char *)b + *off,
+                                     (Py_ssize_t)ln);
+    if (*out == NULL)
+        return -1;
+    *off += (Py_ssize_t)ln;
+    return 0;
+}
+
+/* Signature record -> (type, version, completed, data, cert) tuple or
+ * None for the nil type.  Same return codes as chunk_at. */
+static int
+signature_at(const unsigned char *b, Py_ssize_t n, Py_ssize_t *off,
+             PyObject **out)
+{
+    if (*off == n)
+        return -2;
+    if (*off + 6 > n)
+        return raise_malformed();
+    unsigned typ = b[*off];
+    uint32_t version = rd_u32(b + *off + 1);
+    unsigned completed = b[*off + 5];
+    *off += 6;
+    PyObject *data = NULL, *cert = NULL;
+    /* A record that ends cleanly mid-signature propagates as EOF, not
+     * malformed — the Python reader's EOFError tolerance in parse(). */
+    int rc = chunk_at(b, n, off, &data);
+    if (rc != 0)
+        return rc;
+    rc = chunk_at(b, n, off, &cert);
+    if (rc != 0) {
+        Py_DECREF(data);
+        return rc;
+    }
+    if (typ == 0) { /* SIGNATURE_TYPE_NIL */
+        Py_DECREF(data);
+        Py_DECREF(cert);
+        Py_INCREF(Py_None);
+        *out = Py_None;
+        return 0;
+    }
+    *out = Py_BuildValue("(IIONN)", typ, (unsigned)version,
+                         completed ? Py_True : Py_False, data, cert);
+    return *out == NULL ? -1 : 0;
+}
+
+/* parse(b) -> (variable, value, t, sig, ss, auth); omitted trailing
+ * fields come back as the dataclass defaults (None / 0). */
+static PyObject *
+codec_parse(PyObject *self, PyObject *arg)
+{
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0)
+        return NULL;
+    const unsigned char *b = (const unsigned char *)view.buf;
+    Py_ssize_t n = view.len, off = 0;
+    PyObject *variable = NULL, *value = NULL, *sig = NULL, *ss = NULL,
+             *auth = NULL;
+    uint64_t t = 0;
+    int rc = chunk_at(b, n, &off, &variable);
+    if (rc == -2)
+        raise_malformed();
+    if (rc != 0)
+        goto fail;
+    rc = chunk_at(b, n, &off, &value);
+    if (rc < -1)
+        goto done; /* clean EOF: defaults */
+    if (rc < 0)
+        goto fail;
+    if (off == n)
+        goto done;
+    if (off + 8 > n) {
+        raise_malformed();
+        goto fail;
+    }
+    t = rd_u64(b + off);
+    off += 8;
+    rc = signature_at(b, n, &off, &sig);
+    if (rc == -2)
+        goto done;
+    if (rc < 0)
+        goto fail;
+    rc = signature_at(b, n, &off, &ss);
+    if (rc == -2)
+        goto done;
+    if (rc < 0)
+        goto fail;
+    rc = chunk_at(b, n, &off, &auth);
+    if (rc == -2)
+        goto done;
+    if (rc < 0)
+        goto fail;
+done:
+    PyBuffer_Release(&view);
+    {
+        PyObject *out = Py_BuildValue(
+            "(OOKOOO)", variable ? variable : Py_None,
+            value ? value : Py_None, (unsigned long long)t,
+            sig ? sig : Py_None, ss ? ss : Py_None,
+            auth ? auth : Py_None);
+        Py_XDECREF(variable);
+        Py_XDECREF(value);
+        Py_XDECREF(sig);
+        Py_XDECREF(ss);
+        Py_XDECREF(auth);
+        return out;
+    }
+fail:
+    PyBuffer_Release(&view);
+    Py_XDECREF(variable);
+    Py_XDECREF(value);
+    Py_XDECREF(sig);
+    Py_XDECREF(ss);
+    Py_XDECREF(auth);
+    return NULL;
+}
+
+/* tbs_offset(b) -> offset just past t (malformed if truncated). */
+static Py_ssize_t
+tbs_offset(const unsigned char *b, Py_ssize_t n)
+{
+    Py_ssize_t off = 0;
+    for (int i = 0; i < 2; i++) {
+        if (off + 8 > n) {
+            raise_malformed();
+            return -1;
+        }
+        uint64_t ln = rd_u64(b + off);
+        off += 8;
+        if (ln > (uint64_t)(n - off)) {
+            raise_malformed();
+            return -1;
+        }
+        off += (Py_ssize_t)ln;
+    }
+    off += 8;
+    if (off > n) {
+        raise_malformed();
+        return -1;
+    }
+    return off;
+}
+
+static PyObject *
+codec_tbs_offset(PyObject *self, PyObject *arg)
+{
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0)
+        return NULL;
+    Py_ssize_t off =
+        tbs_offset((const unsigned char *)view.buf, view.len);
+    PyBuffer_Release(&view);
+    if (off < 0)
+        return NULL;
+    return PyLong_FromSsize_t(off);
+}
+
+/* tbss_end(b) -> offset just past sig (for pkt[:end]). */
+static PyObject *
+codec_tbss_end(PyObject *self, PyObject *arg)
+{
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0)
+        return NULL;
+    const unsigned char *b = (const unsigned char *)view.buf;
+    Py_ssize_t n = view.len;
+    Py_ssize_t off = tbs_offset(b, n);
+    if (off < 0) {
+        PyBuffer_Release(&view);
+        return NULL;
+    }
+    PyObject *sig = NULL;
+    int rc = signature_at(b, n, &off, &sig);
+    PyBuffer_Release(&view);
+    if (rc == -2) {
+        raise_malformed();
+        return NULL;
+    }
+    if (rc < 0)
+        return NULL;
+    Py_XDECREF(sig);
+    return PyLong_FromSsize_t(off);
+}
+
+/* parse_signature(b) -> tuple | None */
+static PyObject *
+codec_parse_signature(PyObject *self, PyObject *arg)
+{
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0)
+        return NULL;
+    Py_ssize_t off = 0;
+    PyObject *sig = NULL;
+    int rc = signature_at((const unsigned char *)view.buf, view.len,
+                          &off, &sig);
+    PyBuffer_Release(&view);
+    if (rc == -2) {
+        raise_malformed();
+        return NULL;
+    }
+    if (rc < 0)
+        return NULL;
+    return sig;
+}
+
+/* parse_list(b) -> list[bytes] (empty chunks -> b"") */
+static PyObject *
+codec_parse_list(PyObject *self, PyObject *arg)
+{
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0)
+        return NULL;
+    const unsigned char *b = (const unsigned char *)view.buf;
+    Py_ssize_t n = view.len;
+    if (n < 4) {
+        PyBuffer_Release(&view);
+        raise_malformed();
+        return NULL;
+    }
+    uint32_t count = rd_u32(b);
+    if ((uint64_t)count > (uint64_t)((n - 4) / 8)) {
+        PyBuffer_Release(&view);
+        raise_malformed();
+        return NULL;
+    }
+    PyObject *out = PyList_New(count);
+    if (out == NULL) {
+        PyBuffer_Release(&view);
+        return NULL;
+    }
+    Py_ssize_t off = 4;
+    for (uint32_t i = 0; i < count; i++) {
+        PyObject *c = NULL;
+        int rc = chunk_at(b, n, &off, &c);
+        if (rc == -2)
+            raise_malformed();
+        if (rc != 0) {
+            Py_DECREF(out);
+            PyBuffer_Release(&view);
+            return NULL;
+        }
+        if (c == Py_None) {
+            Py_DECREF(c);
+            c = PyBytes_FromStringAndSize(NULL, 0);
+            if (c == NULL) {
+                Py_DECREF(out);
+                PyBuffer_Release(&view);
+                return NULL;
+            }
+        }
+        PyList_SET_ITEM(out, i, c); /* steals */
+    }
+    PyBuffer_Release(&view);
+    return out;
+}
+
+/* -- serialization ------------------------------------------------------ */
+
+typedef struct {
+    unsigned char *buf;
+    Py_ssize_t len, cap;
+} wbuf;
+
+static int
+wb_grow(wbuf *w, Py_ssize_t need)
+{
+    if (w->len + need <= w->cap)
+        return 0;
+    Py_ssize_t cap = w->cap ? w->cap : 256;
+    while (cap < w->len + need)
+        cap *= 2;
+    unsigned char *nb = PyMem_Realloc(w->buf, cap);
+    if (nb == NULL) {
+        PyErr_NoMemory();
+        return -1;
+    }
+    w->buf = nb;
+    w->cap = cap;
+    return 0;
+}
+
+static int
+wb_u64(wbuf *w, uint64_t v)
+{
+    if (wb_grow(w, 8) < 0)
+        return -1;
+    for (int i = 7; i >= 0; i--)
+        w->buf[w->len++] = (unsigned char)(v >> (8 * i));
+    return 0;
+}
+
+/* obj: bytes-like or None */
+static int
+wb_chunk(wbuf *w, PyObject *obj)
+{
+    if (obj == NULL || obj == Py_None)
+        return wb_u64(w, 0);
+    Py_buffer view;
+    if (PyObject_GetBuffer(obj, &view, PyBUF_SIMPLE) < 0)
+        return -1;
+    int rc = wb_u64(w, (uint64_t)view.len);
+    if (rc == 0 && view.len) {
+        rc = wb_grow(w, view.len);
+        if (rc == 0) {
+            memcpy(w->buf + w->len, view.buf, view.len);
+            w->len += view.len;
+        }
+    }
+    PyBuffer_Release(&view);
+    return rc;
+}
+
+/* sig: None (nil record) or (type, version, completed, data, cert) */
+static int
+wb_signature(wbuf *w, PyObject *sig)
+{
+    unsigned long typ = 0, version = 0;
+    int completed = 0;
+    PyObject *data = Py_None, *cert = Py_None;
+    if (sig != NULL && sig != Py_None) {
+        if (!PyTuple_Check(sig) || PyTuple_GET_SIZE(sig) != 5) {
+            PyErr_SetString(PyExc_TypeError,
+                            "signature must be a 5-tuple or None");
+            return -1;
+        }
+        typ = PyLong_AsUnsignedLong(PyTuple_GET_ITEM(sig, 0));
+        version = PyLong_AsUnsignedLong(PyTuple_GET_ITEM(sig, 1));
+        if (PyErr_Occurred())
+            return -1;
+        completed = PyObject_IsTrue(PyTuple_GET_ITEM(sig, 2));
+        if (completed < 0)
+            return -1;
+        data = PyTuple_GET_ITEM(sig, 3);
+        cert = PyTuple_GET_ITEM(sig, 4);
+        if (typ > 0xFF) {
+            PyErr_SetString(PyExc_ValueError,
+                            "signature type does not fit one byte");
+            return -1;
+        }
+        if (version > 0xFFFFFFFFUL) {
+            /* The Python oracle's struct.pack(">I") rejects this. */
+            PyErr_SetString(PyExc_ValueError,
+                            "signature version does not fit four bytes");
+            return -1;
+        }
+    }
+    if (wb_grow(w, 6) < 0)
+        return -1;
+    w->buf[w->len++] = (unsigned char)typ;
+    for (int i = 3; i >= 0; i--)
+        w->buf[w->len++] = (unsigned char)(version >> (8 * i));
+    w->buf[w->len++] = (unsigned char)(completed ? 1 : 0);
+    if (wb_chunk(w, data) < 0)
+        return -1;
+    return wb_chunk(w, cert);
+}
+
+/* serialize(variable, value, t, sig, ss, auth, nfields) -> bytes */
+static PyObject *
+codec_serialize(PyObject *self, PyObject *args)
+{
+    PyObject *variable, *value, *sig, *ss, *auth;
+    unsigned long long t;
+    int nfields;
+    if (!PyArg_ParseTuple(args, "OOKOOOi", &variable, &value, &t, &sig,
+                          &ss, &auth, &nfields))
+        return NULL;
+    wbuf w = {NULL, 0, 0};
+    int rc = 0;
+    if (nfields >= 1)
+        rc = wb_chunk(&w, variable);
+    if (rc == 0 && nfields >= 2)
+        rc = wb_chunk(&w, value);
+    if (rc == 0 && nfields >= 3)
+        rc = wb_u64(&w, t);
+    if (rc == 0 && nfields >= 4)
+        rc = wb_signature(&w, sig);
+    if (rc == 0 && nfields >= 5)
+        rc = wb_signature(&w, ss);
+    if (rc == 0 && nfields >= 6)
+        rc = wb_chunk(&w, auth);
+    PyObject *out = NULL;
+    if (rc == 0)
+        out = PyBytes_FromStringAndSize((const char *)w.buf, w.len);
+    PyMem_Free(w.buf);
+    return out;
+}
+
+/* serialize_signature(sig_tuple_or_None) -> bytes */
+static PyObject *
+codec_serialize_signature(PyObject *self, PyObject *arg)
+{
+    wbuf w = {NULL, 0, 0};
+    if (wb_signature(&w, arg) < 0) {
+        PyMem_Free(w.buf);
+        return NULL;
+    }
+    PyObject *out = PyBytes_FromStringAndSize((const char *)w.buf, w.len);
+    PyMem_Free(w.buf);
+    return out;
+}
+
+static PyObject *
+codec_set_malformed(PyObject *self, PyObject *arg)
+{
+    Py_XDECREF(Malformed);
+    Py_INCREF(arg);
+    Malformed = arg;
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef methods[] = {
+    {"parse", codec_parse, METH_O,
+     "parse(b) -> (variable, value, t, sig, ss, auth)"},
+    {"tbs_offset", codec_tbs_offset, METH_O, "offset just past t"},
+    {"tbss_end", codec_tbss_end, METH_O, "offset just past sig"},
+    {"parse_signature", codec_parse_signature, METH_O,
+     "parse one signature record"},
+    {"parse_list", codec_parse_list, METH_O, "parse count-prefixed list"},
+    {"serialize", codec_serialize, METH_VARARGS,
+     "serialize(variable, value, t, sig, ss, auth, nfields)"},
+    {"serialize_signature", codec_serialize_signature, METH_O,
+     "serialize one signature record"},
+    {"set_malformed", codec_set_malformed, METH_O,
+     "install the interned malformed-request error class"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_packetcodec",
+    "C codec for the bftkv_tpu wire format", -1, methods,
+};
+
+PyMODINIT_FUNC
+PyInit__packetcodec(void)
+{
+    return PyModule_Create(&moduledef);
+}

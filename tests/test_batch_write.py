@@ -259,3 +259,67 @@ def test_write_many_over_http():
         ]
     finally:
         c.stop()
+
+
+def test_batch_frame_cert_survives_rejected_carrier(cluster):
+    """Mid-join writer: replicas lack the writer's cert and the batch
+    pipeline embeds it on the FIRST item only.  If that carrier item is
+    itself rejected (hidden prefix), the frame-level cert harvest must
+    still resolve the remaining items' signer (round-5 review finding:
+    the harvest originally ran after the per-item policy checks)."""
+    c = cluster.clients[0]
+    cid = c.crypt.signer.cert.id
+    saved = []
+    for s in cluster.all_servers:
+        cert = s.crypt.keyring.get(cid)
+        if cert is not None:
+            saved.append((s, cert))
+            s.crypt.keyring.remove([cid])
+    try:
+        errs = c.write_many(
+            [
+                (b"!!!secret!!!carrier", b"nope"),
+                (b"batch/after-carrier", b"survives"),
+            ]
+        )
+        assert errs[0] == ERR_PERMISSION_DENIED
+        assert errs[1] is None, errs[1]
+        assert c.read(b"batch/after-carrier") == b"survives"
+    finally:
+        for s, cert in saved:
+            s.crypt.keyring.register([cert])
+
+
+def test_batch_overwrite_by_midjoin_writer(cluster):
+    """Mid-join writer OVERWRITES through the batch path.  TOFU in
+    ``_write_storage_checks`` resolves new_issuer for items 2..B from
+    the frame-level cert harvest, and prev_issuer from the stored
+    record — which ``_batch_sign`` must persist self-contained (the
+    carrier's cert restored) or all later overwrites of the variable
+    fail until join gossip lands the writer's cert (round-5 review
+    finding)."""
+    c = cluster.clients[0]
+    cid = c.crypt.signer.cert.id
+    saved = []
+    for s in cluster.all_servers:
+        cert = s.crypt.keyring.get(cid)
+        if cert is not None:
+            saved.append((s, cert))
+            s.crypt.keyring.remove([cid])
+    variables = [b"batch/midjoin-ow-%d" % i for i in range(3)]
+    try:
+        errs = c.write_many([(v, b"gen1-" + v) for v in variables])
+        assert errs == [None] * 3, errs
+        # Overwrite through the batch path: every item, not just the
+        # cert-carrying first one, must pass TOFU on every replica.
+        errs = c.write_many([(v, b"gen2-" + v) for v in variables])
+        assert errs == [None] * 3, errs
+        for v in variables:
+            assert c.read(v) == b"gen2-" + v
+        # And the single path can overwrite a batch-written variable
+        # mid-join too (prev_issuer comes from the stored record).
+        c.write(variables[1], b"gen3")
+        assert c.read(variables[1]) == b"gen3"
+    finally:
+        for s, cert in saved:
+            s.crypt.keyring.register([cert])

@@ -112,3 +112,80 @@ def test_message_envelope_fuzz():
     for _ in range(400):
         for blob in _mutations(rng, genuine):
             _assert_interned(ms.decrypt, blob)
+
+
+# -- C codec differential fuzz ----------------------------------------------
+# The native codec (native/packetcodec.c) must agree with the
+# pure-Python oracle on every input: same value or same interned error.
+
+_HAS_C = pkt._C is not None
+
+
+def _outcome(fn, blob):
+    try:
+        return ("ok", fn(blob))
+    except errors.Error as e:
+        return ("err", str(e))
+    except Exception as e:  # non-interned: the fuzz above already fails these
+        return ("exc", type(e).__name__)
+
+
+@pytest.mark.skipif(not _HAS_C, reason="C codec unavailable")
+def test_c_codec_differential_fuzz():
+    rng = random.Random(7)
+    sig = pkt.SignaturePacket(
+        type=1, version=3, completed=True, data=b"\x05" * 64, cert=b"c" * 33
+    )
+    corpus = [
+        pkt.serialize(b"var", b"value" * 10, 7, sig, sig, b"auth"),
+        pkt.serialize(b"var", None, 9, None, None),
+        pkt.serialize(b"x", nfields=1),
+        pkt.serialize(b"x", b"v", 5, nfields=3),
+        pkt.serialize_list([b"a" * 9, b"", b"q" * 120]),
+        pkt.serialize_signature(sig),
+        b"",
+    ]
+    pairs = [
+        (pkt.parse, pkt._py_parse),
+        (pkt.tbs, pkt._py_tbs),
+        (pkt.tbss, pkt._py_tbss),
+        (pkt.parse_signature, pkt._py_parse_signature),
+        (pkt.parse_list, pkt._py_parse_list),
+    ]
+    for _ in range(300):
+        for genuine in corpus:
+            for blob in _mutations(rng, genuine):
+                for c_fn, py_fn in pairs:
+                    got, want = _outcome(c_fn, blob), _outcome(py_fn, blob)
+                    assert got == want, (
+                        f"{c_fn.__name__}: C={got!r} PY={want!r} "
+                        f"for {blob[:40]!r}"
+                    )
+
+
+@pytest.mark.skipif(not _HAS_C, reason="C codec unavailable")
+def test_c_codec_serialize_matches_python():
+    rng = random.Random(8)
+    for _ in range(500):
+        var = rng.randbytes(rng.randrange(0, 20))
+        val = None if rng.random() < 0.3 else rng.randbytes(rng.randrange(0, 200))
+        t = rng.randrange(0, 2**64)
+        mk = lambda: (
+            None
+            if rng.random() < 0.4
+            else pkt.SignaturePacket(
+                type=rng.choice([0, 1, 2, 255]),
+                version=rng.randrange(0, 2**32),
+                completed=rng.random() < 0.5,
+                data=None if rng.random() < 0.3 else rng.randbytes(10),
+                cert=None if rng.random() < 0.5 else rng.randbytes(10),
+            )
+        )
+        sig, ss = mk(), mk()
+        auth = None if rng.random() < 0.5 else rng.randbytes(8)
+        nfields = rng.choice([None, 1, 2, 3, 4, 5, 6])
+        a = pkt.serialize(var, val, t, sig, ss, auth, nfields=nfields)
+        b = pkt._py_serialize(var, val, t, sig, ss, auth, nfields=nfields)
+        assert a == b
+        s = mk()
+        assert pkt.serialize_signature(s) == pkt._py_serialize_signature(s)

@@ -1,0 +1,81 @@
+"""Quorum-certificate soundness (round-5 /verify findings).
+
+Two properties, found driving a GnuPG-migrated universe end-to-end:
+
+1. The signature-count check must VERIFY each counted signature — the
+   server accepts quorum certificates from certs PRESENTED by writers,
+   so an id-only count would let anyone mint one (forged entries).
+2. A writer may hold a RICHER copy of its own cert (quorum certificate
+   accumulated across replicas / imported from GnuPG rings) than a
+   replica's keyring copy — the presented copy must satisfy the check
+   WITHOUT being persisted into the keyring, because the trust graph
+   derives edges from keyring signature sets and valid third-party
+   certifications must not become edges just by being shown.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from bftkv_tpu.errors import ERR_INVALID_QUORUM_CERTIFICATE
+from tests.cluster_utils import start_cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = start_cluster(4, 1, 4)
+    yield c
+    c.stop()
+
+
+def _strip_keyring_qcert(cluster, cert_id):
+    """Make every replica's keyring copy of the cert signature-sparse,
+    as after a partial migration; returns the removed sets."""
+    saved = []
+    for s in cluster.all_servers:
+        have = s.crypt.keyring.get(cert_id)
+        saved.append((have, dict(have.signatures)))
+        have.signatures.clear()
+        have.__dict__.pop("_qcert_ok", None)
+    return saved
+
+
+def test_rich_presented_cert_satisfies_sparse_keyring(cluster):
+    c = cluster.clients[0]
+    cid = c.crypt.signer.cert.id
+    saved = _strip_keyring_qcert(cluster, cid)
+    try:
+        # Single path and batch path both carry the client's own rich
+        # cert; the replicas' sparse copies must not shadow it.
+        c.write(b"qcert/single", b"v1")
+        assert c.read(b"qcert/single") == b"v1"
+        errs = c.write_many([(b"qcert/b1", b"x"), (b"qcert/b2", b"y")])
+        assert errs == [None, None]
+        # The keyring copies were NOT enriched by the presented cert.
+        for srv, (have, _) in zip(cluster.all_servers, saved):
+            assert have.signatures == {}, (
+                "presented cert leaked into the keyring"
+            )
+    finally:
+        for have, sigs in saved:
+            have.signatures.update(sigs)
+
+
+def test_forged_qcert_entries_not_counted(cluster):
+    c = cluster.clients[0]
+    cert = c.crypt.signer.cert
+    cid = cert.id
+    saved = _strip_keyring_qcert(cluster, cid)
+    real = dict(c.crypt.signer.cert.signatures)
+    try:
+        # Forge: claim every server's id with garbage signature bytes.
+        cert.signatures.clear()
+        for s in cluster.all_servers:
+            cert.signatures[s.self_node.id] = b"\x01" * 256
+        with pytest.raises(ERR_INVALID_QUORUM_CERTIFICATE):
+            c.write(b"qcert/forged", b"evil")
+    finally:
+        cert.signatures.clear()
+        cert.signatures.update(real)
+        for have, sigs in saved:
+            have.signatures.update(sigs)

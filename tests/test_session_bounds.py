@@ -89,3 +89,48 @@ def test_message_security_tables_bounded():
         ms.decrypt(blob)
     assert len(ms._by_id) <= 64
     assert len(ms._by_peer) <= 64
+
+
+def test_auth_attempts_fold_after_midflight_eviction():
+    """ADVICE r4 #1: an AuthServer fetched under _auth_lock is used
+    outside it; if eviction retires it mid-handshake, wrong-password
+    increments made on the retired object must still land in the
+    durable counter (or in the replacement instance) when the handler
+    finishes."""
+    c = _cluster()
+    try:
+        cl = c.clients[0]
+        var = b"bf/race"
+        cl.authenticate(var, b"right")
+        srv = c.servers[0]
+        a = srv._auth[var]
+
+        # Handler holds `a`; TTL eviction retires it concurrently with
+        # attempts=2 recorded at retirement time.
+        a.attempts = 2
+        with srv._auth_lock:
+            srv._auth_evict_locked(now=1e12)
+        assert srv._auth_attempts.get(var) == 2
+
+        # The in-flight handler then increments the retired object
+        # (wrong password inside make_response) and finishes.
+        a.attempts = 3
+        srv._auth_fold_attempts(var, a)
+        assert srv._auth_attempts.get(var) == 3
+
+        # Replacement case: a new instance owns the variable while the
+        # evicted one is still live; fold carries max() into it.
+        cl.authenticate(var, b"right")  # rebuilds the map entry
+        cur = srv._auth[var]
+        assert cur is not a
+        base = cur.attempts
+        a.attempts = base + 5
+        srv._auth_fold_attempts(var, a)
+        assert srv._auth[var].attempts == base + 5
+
+        # And folding a stale lower count never regresses the counter.
+        a.attempts = 1
+        srv._auth_fold_attempts(var, a)
+        assert srv._auth[var].attempts == base + 5
+    finally:
+        c.stop()
