@@ -209,12 +209,16 @@ class VerifyDispatcher(_BatchDispatcher):
 
 
 class SignDispatcher(_BatchDispatcher):
-    """Batched PKCS#1 v1.5 signing (items: (message, PrivateKey)).
+    """Batched signing (items: (message, PrivateKey) — RSA or EC P-256).
 
     The server-side hot loop this absorbs is collective-signature share
-    issuance — one RSA private op per server per sign request
+    issuance — one private op per server per sign request
     (reference: crypto_pgp.go:346-371 via server.go:264) — which
-    otherwise serializes the whole process behind the GIL.
+    otherwise serializes the whole process behind the GIL.  A flush
+    partitions by algorithm: RSA items ride one CRT-modexp launch; EC
+    items group by key and ride one nonce base-mult launch per key
+    (ADVICE r4 #3: EC used to bypass the dispatcher, so concurrent
+    writers' EC batches never coalesced across threads).
     """
 
     name = "signdispatch"
@@ -238,7 +242,33 @@ class SignDispatcher(_BatchDispatcher):
         self.signer = signer
 
     def _run_batch(self, items: list):
-        return self.signer.sign_batch(items)
+        from bftkv_tpu.crypto import cert as certmod
+
+        ec_pos = [i for i, (_, k) in enumerate(items) if certmod.is_ec(k)]
+        if not ec_pos:
+            return self.signer.sign_batch(items)
+        from bftkv_tpu.crypto import ecdsa as _ecdsa
+
+        out: list = [None] * len(items)
+        ec_set = set(ec_pos)
+        rsa_pos = [i for i in range(len(items)) if i not in ec_set]
+        if rsa_pos:
+            for i, sig in zip(
+                rsa_pos, self.signer.sign_batch([items[i] for i in rsa_pos])
+            ):
+                out[i] = sig
+        # Group EC items by key object so each key's messages share one
+        # nonce base-mult launch (ecdsa.sign_batch signs for one key).
+        groups: dict[int, tuple] = {}
+        for i in ec_pos:
+            msg, key = items[i]
+            groups.setdefault(id(key), (key, []))[1].append((i, msg))
+        for key, pairs in groups.values():
+            for (i, _), sig in zip(
+                pairs, _ecdsa.sign_batch([m for _, m in pairs], key)
+            ):
+                out[i] = sig
+        return out
 
     def _combine(self, chunks: list):
         return [sig for chunk in chunks for sig in chunk]

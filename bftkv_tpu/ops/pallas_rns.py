@@ -311,6 +311,50 @@ def _pow_body(invMq_pr, invM_pr, w_steps, *refs):
 
 
 @functools.lru_cache(maxsize=8)
+def _pow_prep(k: int, kpad: int):
+    """Jitted gather/pad prologue, built once per (k, kpad).
+
+    Hoisted out of pow_pallas so the hot sign path doesn't re-trace the
+    prologue on every dispatcher flush (ADVICE r4 #4) — the pallas_call
+    is cached by _pow_call; this keeps prep cached symmetrically.
+    """
+
+    @jax.jit
+    def prep(idx, ukey):
+        n_all, n_r, neg_ninv_b, _ninv, m2_all, m2_r = tuple(
+            u[idx] for u in ukey
+        )
+        pad = lambda x: jnp.pad(x, ((0, 0), (0, kpad - k)))
+        return (
+            pad(n_all[:, :k]), pad(n_all[:, k:]), n_r,
+            pad(neg_ninv_b),
+            pad(m2_all[:, :k]), pad(m2_all[:, k:]), m2_r,
+        )
+
+    return prep
+
+
+@functools.lru_cache(maxsize=8)
+def _verify_prep(k: int, kpad: int):
+    """Jitted gather/pad prologue for the verify chain (see _pow_prep)."""
+
+    @jax.jit
+    def prep(idx, ukey):
+        n_all, n_r, neg_ninv_b, ninv_all, m2_all, m2_r = tuple(
+            u[idx] for u in ukey
+        )
+        pad = lambda x: jnp.pad(x, ((0, 0), (0, kpad - k)))
+        return (
+            pad(n_all[:, :k]), pad(n_all[:, k:]), n_r,
+            pad(neg_ninv_b),
+            pad(ninv_all[:, :k]), pad(ninv_all[:, k:]),
+            pad(m2_all[:, :k]), pad(m2_all[:, k:]), m2_r,
+        )
+
+    return prep
+
+
+@functools.lru_cache(maxsize=8)
 def _pow_call(digits: int, n_bits: int, tile: int, interpret: bool):
     pc = _pad_consts(digits, n_bits)
     kpad, w_steps = pc.kpad, digits * 4
@@ -376,19 +420,7 @@ def pow_pallas(
     run = _pow_call(digits, n_bits, tile, interpret)
 
     # Gather + pad per-row key tensors on device (XLA, outside pallas).
-    @jax.jit
-    def prep(idx, ukey):
-        n_all, n_r, neg_ninv_b, _ninv, m2_all, m2_r = tuple(
-            u[idx] for u in ukey
-        )
-        pad = lambda x: jnp.pad(x, ((0, 0), (0, kpad - k)))
-        return (
-            pad(n_all[:, :k]), pad(n_all[:, k:]), n_r,
-            pad(neg_ninv_b),
-            pad(m2_all[:, :k]), pad(m2_all[:, k:]), m2_r,
-        )
-
-    nb, nq, nr, ninvb, m2b, m2q, m2r = prep(
+    nb, nq, nr, ninvb, m2b, m2q, m2r = _pow_prep(k, kpad)(
         jnp.asarray(idx), tuple(jnp.asarray(u) for u in ukey)
     )
     return run(
@@ -497,20 +529,9 @@ def verify_pallas(
     k, kpad = pc.k, pc.kpad
     run = _verify_call(digits, n_bits, tile, interpret)
 
-    @jax.jit
-    def prep(idx, ukey):
-        n_all, n_r, neg_ninv_b, ninv_all, m2_all, m2_r = tuple(
-            u[idx] for u in ukey
-        )
-        pad = lambda x: jnp.pad(x, ((0, 0), (0, kpad - k)))
-        return (
-            pad(n_all[:, :k]), pad(n_all[:, k:]), n_r,
-            pad(neg_ninv_b),
-            pad(ninv_all[:, :k]), pad(ninv_all[:, k:]),
-            pad(m2_all[:, :k]), pad(m2_all[:, k:]), m2_r,
-        )
-
-    args = prep(jnp.asarray(idx), tuple(jnp.asarray(u) for u in ukey))
+    args = _verify_prep(k, kpad)(
+        jnp.asarray(idx), tuple(jnp.asarray(u) for u in ukey)
+    )
     return run(
         jnp.asarray(sig_halves_u8).astype(jnp.float32),
         jnp.asarray(em_halves_u8).astype(jnp.float32),

@@ -99,3 +99,84 @@ def test_stopped_dispatcher_falls_back(keypair):
     # not started: verify() still works synchronously
     assert d.verify(_items(key, pub, 2)).all()
     assert d.verify([]).shape == (0,)
+
+
+def test_sign_dispatcher_mixed_rsa_ec_batch(keypair):
+    """One flush may carry RSA and EC items interleaved (ADVICE r4 #3);
+    every signature must come back in submission order, each verified
+    by its own algorithm."""
+    from bftkv_tpu.crypto import ecdsa
+
+    key, pub = keypair
+    ec_key = ecdsa.generate()
+    d = dispatch.SignDispatcher(max_batch=64, max_wait=0.01).start()
+    try:
+        items = [
+            (b"rsa-0", key),
+            (b"ec-0", ec_key),
+            (b"rsa-1", key),
+            (b"ec-1", ec_key),
+        ]
+        sigs = d.submit(items)
+        assert len(sigs) == 4
+        assert rsa.verify_host(b"rsa-0", sigs[0], pub)
+        assert ecdsa.verify_host(b"ec-0", sigs[1], ec_key.public)
+        assert rsa.verify_host(b"rsa-1", sigs[2], pub)
+        assert ecdsa.verify_host(b"ec-1", sigs[3], ec_key.public)
+    finally:
+        d.stop()
+
+
+def test_ec_signers_coalesce_across_threads():
+    """Concurrent EC writers' batches merge into shared flushes, the
+    same coalescing the RSA path has always had (ADVICE r4 #3)."""
+    from bftkv_tpu.crypto import ecdsa
+
+    ec_key = ecdsa.generate()
+    metrics.reset()
+    d = dispatch.SignDispatcher(max_batch=4096, max_wait=0.05).start()
+    results = {}
+    try:
+        def worker(i):
+            msgs = [b"t%d-m%d" % (i, j) for j in range(4)]
+            results[i] = (msgs, d.submit([(m, ec_key) for m in msgs]))
+
+        threads = [
+            threading.Thread(target=worker, args=(i,)) for i in range(8)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        for msgs, sigs in results.values():
+            for m, s in zip(msgs, sigs):
+                assert ecdsa.verify_host(m, s, ec_key.public)
+        snap = metrics.snapshot()
+        assert snap["signdispatch.items"] == 32
+        assert snap["signdispatch.flushes"] < 8
+    finally:
+        d.stop()
+        metrics.reset()
+
+
+def test_signer_issue_many_routes_ec_through_dispatcher():
+    """Signer.issue_many submits EC work to the installed dispatcher
+    instead of signing inline in the caller's thread."""
+    from bftkv_tpu.crypto import cert as certmod
+    from bftkv_tpu.crypto import ecdsa
+    from bftkv_tpu.crypto.signature import Signer, verify_with_certificate
+
+    ec_key = ecdsa.generate()
+    cert = certmod.make_ec_certificate(ec_key.public, name="ec-d", uid="ec-d")
+    metrics.reset()
+    dispatch.install_signer(
+        dispatch.SignDispatcher(max_batch=8, max_wait=0.005)
+    )
+    try:
+        pkts = Signer(ec_key, cert).issue_many([b"a", b"b"])
+        for tbs, pkt in zip([b"a", b"b"], pkts):
+            verify_with_certificate(tbs, pkt, cert)
+        assert metrics.snapshot().get("signdispatch.items", 0) >= 2
+    finally:
+        dispatch.uninstall_signer()
+        metrics.reset()
