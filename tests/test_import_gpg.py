@@ -1,0 +1,231 @@
+"""GnuPG keyring importer: migrate a reference-shaped universe.
+
+Builds a miniature version of the reference's key universe with real
+GnuPG (scripts/setup.sh:17-48 shape: per-node homedirs, cross-signed
+via export/sign/import like scripts/trust.sh), then imports it and
+checks that identities, secret keys, and VERIFIED trust edges all
+arrive natively — and that a tampered certification is rejected.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+from bftkv_tpu.cmd import import_gpg
+
+GPG = shutil.which("gpg")
+pytestmark = pytest.mark.skipif(GPG is None, reason="gpg not installed")
+
+
+def _gpg(home, *args, stdin: bytes | None = None) -> bytes:
+    os.makedirs(home, mode=0o700, exist_ok=True)
+    out = subprocess.run(
+        [GPG, "--homedir", home, "--batch", "--no-tty", "--yes",
+         "--pinentry-mode", "loopback", "--passphrase", "", *args],
+        input=stdin, capture_output=True, check=True,
+    )
+    return out.stdout
+
+
+def _fpr(home: str) -> str:
+    out = _gpg(home, "--list-keys", "--with-colons").decode()
+    for line in out.splitlines():
+        if line.startswith("fpr:"):
+            return line.split(":")[9]
+    raise AssertionError("no fingerprint")
+
+
+@pytest.fixture(scope="module")
+def universe(tmp_path_factory):
+    """Three nodes; a01 signs rw01's key, rw01 signs a01 and u01
+    (trust.sh semantics: signer imports the signed key into its own
+    ring).  Every node dir gets pubring.gpg + secring.gpg like
+    gen.sh."""
+    root = tmp_path_factory.mktemp("gpgu")
+    uids = {
+        "a01": "a01 (localhost:5701) <svc@example.com>",
+        "rw01": "rw01 (localhost:5601) <svc@example.com>",
+        "u01": "u01 <foo@example.com>",
+    }
+    homes = {}
+    for name, uid in uids.items():
+        home = str(root / f".{name}")
+        _gpg(home, "--quick-gen-key", uid, "rsa2048", "sign", "never")
+        homes[name] = home
+
+    def cross_sign(signer: str, signee: str) -> None:
+        # trust.sh "both" mode: the signed key lands in the signer's
+        # ring AND is re-imported into the signee's ring.
+        pub = _gpg(homes[signee], "--export")
+        _gpg(homes[signer], "--import", stdin=pub)
+        _gpg(homes[signer], "--quick-sign-key", _fpr(homes[signee]))
+        signed = _gpg(homes[signer], "--export", _fpr(homes[signee]))
+        _gpg(homes[signee], "--import", stdin=signed)
+
+    cross_sign("a01", "rw01")
+    cross_sign("rw01", "a01")
+    cross_sign("rw01", "u01")
+
+    dirs = {}
+    for name, home in homes.items():
+        d = root / name
+        d.mkdir()
+        (d / "pubring.gpg").write_bytes(_gpg(home, "--export"))
+        (d / "secring.gpg").write_bytes(_gpg(home, "--export-secret-key"))
+        dirs[name] = str(d)
+    return dirs
+
+
+def test_full_universe_import(universe, tmp_path):
+    res = import_gpg.import_homedirs(list(universe.values()))
+    assert len(res.certs) == 3
+    assert len(res.secrets) == 3  # every homedir contributed its key
+    by_name = {c.name: c for c in res.certs.values()}
+    assert set(by_name) == {"a01", "rw01", "u01"}
+    assert by_name["a01"].address == "localhost:5701"
+    assert by_name["a01"].uid == "svc@example.com"
+    assert by_name["u01"].address == ""
+
+    # All three certifications became NATIVE, verifiable signatures.
+    got = {
+        (s, t) for s, t in res.edges
+    }
+    want = {
+        (by_name["a01"].id, by_name["rw01"].id),
+        (by_name["rw01"].id, by_name["a01"].id),
+        (by_name["rw01"].id, by_name["u01"].id),
+    }
+    assert got == want
+    assert res.unconverted == []
+    for signer_id, signee_id in got:
+        signer = res.certs[signer_id]
+        assert res.certs[signee_id].verify_signature(signer)
+
+    # The written homes round-trip through the daemon loader.
+    out = tmp_path / "native"
+    written = import_gpg.write_native_homes(res, str(out))
+    assert len(written) == 3
+    from bftkv_tpu.topology import load_home
+
+    graph, crypt, qs = load_home(str(out / "rw01"))
+    assert crypt.signer.cert.name == "rw01"
+    # rw01's graph sees its edge onto a01 (a real cert signature edge).
+    reachable = {
+        c.id for c in graph.get_reachable_nodes(by_name["rw01"].id, 1)
+    }
+    assert by_name["a01"].id in reachable
+
+
+def test_single_homedir_unknown_issuer_dropped(universe):
+    # Importing ONLY u01's homedir: rw01's certification rides u01's
+    # ring but rw01's PUBLIC key does not — the edge is unverifiable
+    # and must be dropped with a note, never converted on faith.
+    res = import_gpg.import_homedirs([universe["u01"]])
+    by_name = {c.name: c for c in res.certs.values()}
+    assert "u01" in by_name
+    assert len(res.secrets) == 1
+    assert res.edges == []
+    assert res.unconverted == []
+    assert any("unverifiable" in n for n in res.notes)
+
+
+def test_verified_edge_without_signer_secret_unconverted(universe, tmp_path):
+    # u01's homedir plus a PUBLIC-only copy of rw01's: the rw01->u01
+    # certification now verifies, but rw01's secret key is absent —
+    # the edge must be reported as unconverted, never forged.
+    rw_pub = tmp_path / "rw01-pubonly"
+    rw_pub.mkdir()
+    with open(os.path.join(universe["rw01"], "pubring.gpg"), "rb") as f:
+        (rw_pub / "pubring.gpg").write_bytes(f.read())
+    res = import_gpg.import_homedirs([universe["u01"], str(rw_pub)])
+    by_name = {c.name: c for c in res.certs.values()}
+    assert len(res.secrets) == 1  # only u01's
+    # rw01's ring carries rw01->a01 and rw01->u01; neither can be
+    # re-signed without rw01's secret.
+    assert (by_name["rw01"].id, by_name["u01"].id) not in set(res.edges)
+    assert any(t == by_name["u01"].id for _, t in res.unconverted)
+    # The unforged edge is NOT embedded in the cert.
+    assert by_name["rw01"].id not in by_name["u01"].signatures
+
+
+def test_tampered_certification_rejected(universe):
+    # rw01's pubring carries verifiable certifications (it holds the
+    # issuer keys).  Flip a byte near the end of the ring — inside the
+    # last signature's MPI — and confirm the importer rejects rather
+    # than converts the damaged certification.
+    with open(os.path.join(universe["rw01"], "pubring.gpg"), "rb") as f:
+        intact_bytes = f.read()
+    intact = import_gpg.parse_keyring(intact_bytes)
+    intact_edges = sum(
+        len(k.certified_by) for k in intact.keys.values()
+    )
+    assert intact_edges >= 2  # rw01->a01, rw01->u01 at least
+
+    data = bytearray(intact_bytes)
+    data[-10] ^= 0x40
+    ring = import_gpg.parse_keyring(bytes(data))
+    tampered_edges = sum(
+        len(k.certified_by) for k in ring.keys.values()
+    )
+    # The damaged certification must be lost or loudly rejected —
+    # never silently kept.
+    assert tampered_edges < intact_edges or any(
+        "BAD certification" in n or "parse error" in n for n in ring.notes
+    )
+
+
+def test_protected_secret_key_skipped(tmp_path):
+    home = str(tmp_path / ".prot")
+    os.makedirs(home, mode=0o700)
+    subprocess.run(
+        [GPG, "--homedir", home, "--batch", "--no-tty", "--yes",
+         "--pinentry-mode", "loopback", "--passphrase", "hunter2",
+         "--quick-gen-key", "prot <p@x>", "rsa2048", "sign", "never"],
+        capture_output=True, check=True,
+    )
+    d = tmp_path / "prot"
+    d.mkdir()
+    out = subprocess.run(
+        [GPG, "--homedir", home, "--batch", "--no-tty", "--yes",
+         "--pinentry-mode", "loopback", "--passphrase", "hunter2",
+         "--export-secret-key"],
+        capture_output=True, check=True,
+    ).stdout
+    (d / "secring.gpg").write_bytes(out)
+    res = import_gpg.import_homedirs([str(d)])
+    # Identity imports; the protected secret is skipped, not decrypted.
+    assert len(res.certs) == 1
+    assert res.secrets == {}
+
+
+def test_written_homes_keep_ring_locality(universe, tmp_path):
+    """Per-home views (round-5 /verify finding): a home's pubring holds
+    its OWN ring's view; the owner's outbound certifications become
+    localtrust (local-only graph edges), never cert signatures — a
+    union view would pull users into server cliques (DESIGN.md §1.2)."""
+    import os as _os
+
+    res = import_gpg.import_homedirs(list(universe.values()))
+    out = tmp_path / "homes"
+    import_gpg.write_native_homes(res, str(out))
+    by_name = {c.name: c for c in res.certs.values()}
+
+    from bftkv_tpu.crypto.keyring import Keyring
+
+    ring = Keyring()
+    view = ring.load_pubring(str(out / "rw01" / "pubring"))
+    certs = {c.name: c for c in view}
+    # rw01's own outbound edge (rw01 signed a01 and u01) is NOT a cert
+    # signature in its home...
+    assert by_name["rw01"].id not in certs["a01"].signatures
+    assert by_name["rw01"].id not in certs["u01"].signatures
+    # ...it is localtrust instead.
+    with open(_os.path.join(str(out / "rw01"), "localtrust")) as f:
+        lt = {int(line, 16) for line in f if line.strip()}
+    assert by_name["a01"].id in lt and by_name["u01"].id in lt
+    # Inbound edges stay as real signatures (a01 -> rw01).
+    assert by_name["a01"].id in certs["rw01"].signatures
