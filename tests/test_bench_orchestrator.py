@@ -15,7 +15,13 @@ subprocesses and pin the policy:
   stdout tail can never truncate away the headline (r04's failure),
   with the full record in BENCH_detail.json and on stderr;
 - cached captures carry a code fingerprint; reuse after a source change
-  is flagged `cached_stale_code` (ADVICE r4 #2).
+  is flagged `cached_stale_code` (ADVICE r4 #2);
+- a probe failure before one section does NOT doom the rest of the run:
+  the orchestrator re-probes (bounded) and resumes live on a revived
+  tunnel (r05: a mid-run flap skipped 13 sections permanently);
+- the headline prefers TPU-backed sections over CPU fallbacks (r04's
+  headline was CPU cluster_4 while a TPU kernel capture sat cached);
+- each section gets its own timeout budget so a hang costs minutes.
 """
 
 from __future__ import annotations
@@ -39,6 +45,7 @@ def bench(tmp_path, monkeypatch):
     monkeypatch.setattr(mod, "PARTIAL_PATH", str(tmp_path / "partial.json"))
     monkeypatch.setattr(mod, "DETAIL_PATH", str(tmp_path / "detail.json"))
     monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.delenv("BENCH_SECTION_TIMEOUT", raising=False)
     monkeypatch.setenv("BENCH_CONFIGS", "tally")
     return mod
 
@@ -241,6 +248,115 @@ def test_hung_child_falls_back_to_cache(bench, monkeypatch, capsys):
     assert sec["tallies_per_sec"] == 999.0
     assert sec["cached_from"] == "2026-07-30T12:00:00Z"
     assert compact["extra"]["sections"]["revoke_tally_256"] == ["cached", 999.0]
+
+
+def test_probe_recovers_mid_run(bench, monkeypatch, capsys):
+    """A tunnel that dies before one section and revives before the
+    next resumes live capture (the r05 flap skipped everything after
+    one failed probe)."""
+    monkeypatch.setenv("BENCH_CONFIGS", "modexp,tally")
+    probes = iter([False, True])
+    monkeypatch.setattr(bench, "_probe_backend", lambda t: next(probes))
+    monkeypatch.setattr(
+        bench,
+        "_run_child",
+        lambda token, t, force_cpu: {
+            "section": bench.SECTION_NAMES[token],
+            "backend": "cpu" if force_cpu else "tpu",
+            "devices": ["TPU_0"],
+            "jax": "x",
+            "result": {"tallies_per_sec": 5.0},
+        },
+    )
+    compact, detail = _run_main(bench, capsys)
+    assert detail["extra"]["modexp_kernel"].get("skipped")
+    assert compact["extra"]["sections"]["revoke_tally_256"] == ["tpu", 5.0]
+
+
+def test_probe_failures_bounded(bench, monkeypatch, capsys):
+    """A dead-all-day tunnel costs at most 3 probe timeouts, not one
+    per section (driver-time budget)."""
+    monkeypatch.setenv("BENCH_CONFIGS", "rns,sign,kernel,ec,modexp,thr")
+    calls = []
+    monkeypatch.setattr(
+        bench, "_probe_backend", lambda t: calls.append(t) or False
+    )
+    monkeypatch.setattr(
+        bench, "_run_child",
+        lambda *a, **k: pytest.fail("no child on a dead tunnel"),
+    )
+    _run_main(bench, capsys)
+    assert len(calls) == 3
+
+
+def test_headline_prefers_tpu_backed_section(bench, monkeypatch, capsys):
+    """A cached TPU kernel rate outranks a live CPU-fallback cluster
+    number in headline selection (r04 regression)."""
+    monkeypatch.setenv("BENCH_CONFIGS", "rns,c4")
+    bench._save_partial(
+        {
+            "sections": {
+                "rns_kernel": {
+                    "backend": "tpu",
+                    "jax": "x",
+                    "devices": ["TPU_0"],
+                    "captured": "2026-07-31T03:49:29Z",
+                    "fast_mode": False,
+                    "code": bench._code_fingerprint(),
+                    "result": {"best_verifies_per_sec": 550684.8},
+                }
+            }
+        }
+    )
+    monkeypatch.setattr(bench, "_probe_backend", lambda t: False)
+    monkeypatch.setattr(
+        bench,
+        "_run_child",
+        lambda token, t, force_cpu: {
+            "section": bench.SECTION_NAMES[token],
+            "backend": "cpu",
+            "devices": ["CPU_0"],
+            "jax": "x",
+            "result": {"writes_per_sec": 6.72},
+        },
+    )
+    compact, detail = _run_main(bench, capsys)
+    assert compact["metric"] == "rsa2048_verifies_per_sec"
+    assert compact["value"] == 550684.8
+    assert compact["extra"]["headline_from"] == "rns_kernel"
+    # The CPU cluster number still rides along in the record.
+    assert detail["extra"]["cluster_4"]["writes_per_sec"] == 6.72
+
+
+def test_per_section_timeout_budgets(bench, monkeypatch, capsys):
+    """Sections get sized timeouts (a hung kernel section must not burn
+    a cluster-sized budget); BENCH_SECTION_TIMEOUT overrides."""
+    monkeypatch.setenv("BENCH_CONFIGS", "modexp,b64")
+    monkeypatch.setattr(bench, "_probe_backend", lambda t: True)
+    seen = {}
+
+    def child(token, timeout, force_cpu):
+        seen[token] = timeout
+        return {
+            "section": bench.SECTION_NAMES[token],
+            "backend": "tpu",
+            "devices": ["TPU_0"],
+            "jax": "x",
+            "result": {"x_per_sec": 1.0},
+        }
+
+    monkeypatch.setattr(bench, "_run_child", child)
+    _run_main(bench, capsys)
+    assert seen == {
+        "modexp": bench.TOKEN_TIMEOUT["modexp"],
+        "b64": bench.TOKEN_TIMEOUT["b64"],
+    }
+    assert seen["modexp"] < seen["b64"]
+
+    monkeypatch.setenv("BENCH_SECTION_TIMEOUT", "123")
+    seen.clear()
+    _run_main(bench, capsys)
+    assert seen == {"modexp": 123.0, "b64": 123.0}
 
 
 def test_final_stdout_line_stays_small(bench, monkeypatch, capsys):
