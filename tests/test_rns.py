@@ -116,3 +116,61 @@ def test_rns_padding_rows_never_verify(keys):
     sig = rsa.sign(b"solo", key)
     ok = dom.verify_batch([(b"solo", sig, key.public)])
     assert ok.shape == (1,) and ok[0]
+
+
+def test_pallas_auto_gated_on_per_chain_proof(monkeypatch, tmp_path):
+    """Auto mode routes through a fused Pallas chain only on a single
+    real TPU chip AND after that chain has a proven-completion marker;
+    a verify-only proof must not arm the pow chain (r5 code review)."""
+    monkeypatch.setattr(rns.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(rns.jax, "devices", lambda: ["chip0"])
+    monkeypatch.setattr(
+        rns, "_pallas_proven_path",
+        lambda which: str(tmp_path / f"marker_{which}"),
+    )
+    rns._pallas_proven.cache_clear()
+    try:
+        # No marker: auto never selects pallas, even on "tpu".
+        assert rns._use_pallas("BFTKV_RNS_POW_BACKEND") is False
+        assert rns._use_pallas("BFTKV_RNS_VERIFY_BACKEND") is False
+        # A verify proof arms verify only.
+        (tmp_path / "marker_verify").touch()
+        rns._pallas_proven.cache_clear()
+        assert rns._use_pallas("BFTKV_RNS_VERIFY_BACKEND") is True
+        assert rns._use_pallas("BFTKV_RNS_POW_BACKEND") is False
+        # Forced modes ignore the marker in both directions.
+        monkeypatch.setenv("BFTKV_RNS_VERIFY_BACKEND", "xla")
+        assert rns._use_pallas("BFTKV_RNS_VERIFY_BACKEND") is False
+        monkeypatch.setenv("BFTKV_RNS_POW_BACKEND", "pallas")
+        assert rns._use_pallas("BFTKV_RNS_POW_BACKEND") is True
+        # Multi-chip pools stay on the sharded XLA path in auto.
+        monkeypatch.delenv("BFTKV_RNS_VERIFY_BACKEND")
+        monkeypatch.setattr(rns.jax, "devices", lambda: ["c0", "c1"])
+        (tmp_path / "marker_pow").touch()
+        rns._pallas_proven.cache_clear()
+        assert rns._use_pallas("BFTKV_RNS_VERIFY_BACKEND") is False
+    finally:
+        rns._pallas_proven.cache_clear()
+
+
+def test_pallas_mark_proven_no_marker_off_tpu(monkeypatch, tmp_path):
+    """Status flips to ok everywhere, but the cross-process marker is
+    only written where it was actually proven: on a real TPU backend."""
+    monkeypatch.setattr(
+        rns, "_pallas_proven_path",
+        lambda which: str(tmp_path / f"marker_{which}"),
+    )
+    monkeypatch.setattr(rns, "_PALLAS_STATUS", {"pow": "unused", "verify": "unused"})
+    rns._pallas_mark_proven("pow")  # backend is cpu under the test env
+    assert rns.pallas_status()["pow"] == "ok"
+    assert not (tmp_path / "marker_pow").exists()
+    try:
+        monkeypatch.setattr(rns.jax, "default_backend", lambda: "tpu")
+        rns._pallas_mark_proven("verify")
+        assert (tmp_path / "marker_verify").exists()
+        # Early return: a second call must not touch the path again.
+        (tmp_path / "marker_verify").unlink()
+        rns._pallas_mark_proven("verify")
+        assert not (tmp_path / "marker_verify").exists()
+    finally:
+        rns._pallas_proven.cache_clear()

@@ -17,7 +17,9 @@ PY
     echo "$(date -u +%FT%TZ) bench rc=$? done" >> tpu_poller.log
     sleep 60
   else
+    # Short sleep: observed live windows are ~8 min; a 2-min cadence
+    # (plus up-to-90s probe) can miss half a window.
     echo "$(date -u +%FT%TZ) probe: dead" >> tpu_poller.log
-    sleep 120
+    sleep 45
   fi
 done
