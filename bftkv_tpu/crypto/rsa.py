@@ -454,6 +454,9 @@ class VerifierDomain:
         self._cache: "OrderedDict[int, bigint.MontgomeryDomain | None]" = (
             OrderedDict()
         )
+        # Pipelined dispatcher flushes call verify_batch from multiple
+        # worker threads; the LRU mutations must not race.
+        self._cache_lock = threading.Lock()
 
     def _dom(self, n: int) -> bigint.MontgomeryDomain | None:
         """Montgomery domain for ``n``, or None if ``n`` is unusable.
@@ -462,17 +465,19 @@ class VerifierDomain:
         fresh moduli, so an unbounded cache would grow with attacker
         traffic (one precomputation + dict entry per distinct n).
         """
-        dom = self._cache.get(n, False)
-        if dom is False:
-            try:
-                dom = bigint.MontgomeryDomain(n, self.nlimbs)
-            except ValueError:
-                dom = None
+        with self._cache_lock:
+            dom = self._cache.get(n, False)
+            if dom is not False:
+                self._cache.move_to_end(n)
+                return dom
+        try:
+            dom = bigint.MontgomeryDomain(n, self.nlimbs)
+        except ValueError:
+            dom = None
+        with self._cache_lock:
             self._cache[n] = dom
             if len(self._cache) > self._CACHE_MAX:
                 self._cache.popitem(last=False)
-        else:
-            self._cache.move_to_end(n)
         return dom
 
     def assemble(

@@ -180,3 +180,119 @@ def test_signer_issue_many_routes_ec_through_dispatcher():
     finally:
         dispatch.uninstall_signer()
         metrics.reset()
+
+
+def test_pipelined_flushes_interleave_and_stay_correct(keypair):
+    """With pipeline=2 a flush waiting on the device must not block the
+    next flush from launching (r5: overlap hides the ~100 ms tunneled
+    launch RTT behind host assembly).  Deterministic: flush 1 BLOCKS
+    until flush 2 has entered _run_batch — if flushes were serial this
+    would deadlock (and the waits would time out and fail)."""
+    key, pub = keypair
+    d = dispatch.VerifyDispatcher(max_batch=8, max_wait=0.5, pipeline=2)
+    inner = d._run_batch
+    first_in = threading.Event()
+    second_in = threading.Event()
+    n_calls = []
+    lock = threading.Lock()
+
+    def run(items):
+        with lock:
+            n_calls.append(len(items))
+            rank = len(n_calls)
+        if rank == 1:
+            first_in.set()
+            assert second_in.wait(timeout=20), (
+                "second flush never launched while the first was "
+                "in flight: flushes are serial"
+            )
+        else:
+            second_in.set()
+        return inner(items)
+
+    d._run_batch = run
+    d.start()
+    try:
+        results = {}
+        # 8 items == max_batch: each submit drains as its own immediate
+        # flush (no timer involved, no cross-submit coalescing race).
+        t1 = threading.Thread(
+            target=lambda: results.setdefault(1, d.verify(_items(key, pub, 8)))
+        )
+        t1.start()
+        assert first_in.wait(timeout=10)
+        t2 = threading.Thread(
+            target=lambda: results.setdefault(2, d.verify(_items(key, pub, 8)))
+        )
+        t2.start()
+        t1.join(timeout=30)
+        t2.join(timeout=30)
+        assert not t1.is_alive() and not t2.is_alive()
+        assert results[1].all() and results[2].all()
+        assert len(n_calls) == 2 and all(n == 8 for n in n_calls)
+    finally:
+        d.stop()
+
+
+def test_stop_drains_inflight_flushes(keypair):
+    """stop() must not return while a pipelined flush worker still owes
+    a caller its result."""
+    key, pub = keypair
+    d = dispatch.VerifyDispatcher(max_batch=4, max_wait=0.001, pipeline=2)
+    inner = d._run_batch
+    started = threading.Event()
+
+    def slow_run(items):
+        started.set()
+        import time
+
+        time.sleep(0.2)
+        return inner(items)
+
+    d._run_batch = slow_run
+    d.start()
+    try:
+        got = {}
+        t = threading.Thread(
+            target=lambda: got.setdefault("ok", d.verify(_items(key, pub, 4)))
+        )
+        t.start()
+        started.wait(timeout=5)
+    finally:
+        d.stop()
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert got["ok"].all()
+
+
+def test_pipeline_one_restores_serial_flushing(keypair):
+    key, pub = keypair
+    d = dispatch.VerifyDispatcher(max_batch=4, max_wait=0.001, pipeline=1)
+    peak, inflight = [], []
+    gate = threading.Lock()
+    inner = d._run_batch
+
+    def counting_run(items):
+        with gate:
+            inflight.append(1)
+            peak.append(len(inflight))
+        try:
+            return inner(items)
+        finally:
+            with gate:
+                inflight.pop()
+
+    d._run_batch = counting_run
+    d.start()
+    try:
+        threads = [
+            threading.Thread(target=lambda: d.verify(_items(key, pub, 4)))
+            for _ in range(6)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert max(peak) == 1, peak
+    finally:
+        d.stop()
