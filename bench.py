@@ -45,6 +45,9 @@ REPO = os.path.dirname(os.path.abspath(__file__))
 sys.path.insert(0, REPO)
 
 NORTH_STAR_WRITES_PER_SEC = 50_000.0
+# What one replica must verify/sec for the north star (44 verifies per
+# cluster write at n=64 — docs/PERFORMANCE.md "The scaling math").
+NORTH_STAR_VERIFIES_PER_SEC = 2_200_000.0
 
 FAST = os.environ.get("BENCH_FAST") == "1"
 
@@ -245,11 +248,12 @@ def bench_kernel_rns(batches=(4096, 16384, 65536)) -> dict:
             "first_call_s": round(compile_s, 2),
         }
     # Production-path comparison (verify_e65537_rns_indexed: u8
-    # transfer + on-device key gather) under BOTH backends at the two
-    # largest batches.  Forced-Pallas completing here writes the proven
-    # marker that arms auto mode for the cluster sections; the exported
-    # pallas_status says whether the fused chain really ran or the loud
-    # XLA fallback fired (VERDICT r4 item 3).
+    # transfer + on-device key gather) under BOTH backends — XLA at the
+    # two largest batches, Pallas at the largest only (each batch shape
+    # is its own Mosaic compile).  Forced-Pallas completing here writes
+    # the proven marker that arms auto mode for the cluster sections;
+    # the exported pallas_status says whether the fused chain really
+    # ran or the loud XLA fallback fired (VERDICT r4 item 3).
     urows = rns.stack_key_rows([row])
     # Forced-Pallas only on real TPU: interpret mode on CPU takes
     # minutes per batch and proves nothing about the Mosaic path.
@@ -258,7 +262,9 @@ def bench_kernel_rns(batches=(4096, 16384, 65536)) -> dict:
         dest = out.setdefault(f"indexed_{mode}", {"batch": {}})["batch"]
         os.environ["BFTKV_RNS_VERIFY_BACKEND"] = mode
         try:
-            for b in sorted(batches)[-2:]:
+            # Pallas at the largest batch only (one Mosaic compile per
+            # window); XLA keeps two sizes for the amortization curve.
+            for b in sorted(batches)[-2:] if mode == "xla" else sorted(batches)[-1:]:
                 sig_d = np.tile(sig, (b // 32 + 1, 1))[:b]
                 em_d = np.tile(em, (b // 32 + 1, 1))[:b]
                 idx = np.zeros(b, dtype=np.int32)
@@ -315,7 +321,10 @@ def bench_kernel_sign(batches=(256, 1024, 4096)) -> dict:
     out: dict = {"batch": {}, "backend": sd.backend}
     plan = [("xla", sorted(batches))]
     if jax.default_backend() == "tpu":  # interpret mode proves nothing
-        plan.append(("pallas", sorted(batches)[-2:]))
+        # Largest batch only: every batch shape is its own Mosaic
+        # compile, and a short tunnel window should spend its minutes
+        # measuring, not compiling.
+        plan.append(("pallas", sorted(batches)[-1:]))
     for mode, bs in plan:
         dest = (
             out["batch"]
@@ -1315,13 +1324,20 @@ def main() -> None:
         if headline_from:
             break
     is_writes = unit == "writes/s" and metric != "no_configs_selected"
+    if is_writes:
+        vs = round(value / NORTH_STAR_WRITES_PER_SEC, 5)
+    elif unit == "verifies/s":
+        # Kernel headline (no TPU cluster capture yet): ratio against
+        # the per-replica verify rate the 50k-writes/s north star
+        # implies, so the driver still gets a meaningful fraction.
+        vs = round(value / NORTH_STAR_VERIFIES_PER_SEC, 5)
+    else:
+        vs = None
     record = {
         "metric": metric,
         "value": value,
         "unit": unit,
-        "vs_baseline": round(value / NORTH_STAR_WRITES_PER_SEC, 5)
-        if is_writes
-        else None,
+        "vs_baseline": vs,
         "extra": extra,
     }
 

@@ -149,7 +149,11 @@ class _BatchDispatcher:
             self._inflight = threading.BoundedSemaphore(self.pipeline)
             self._work = queue.Queue()
             self._workers = [
-                threading.Thread(target=self._flush_worker, daemon=True)
+                threading.Thread(
+                    target=self._flush_worker,
+                    args=(self._work, self._inflight),
+                    daemon=True,
+                )
                 for _ in range(self.pipeline)
             ]
             for w in self._workers:
@@ -179,15 +183,19 @@ class _BatchDispatcher:
             self._work = None
             self._inflight = None
 
-    def _flush_worker(self) -> None:
+    def _flush_worker(self, work, inflight) -> None:
+        # Queue + semaphore ride in as locals: a worker abandoned by a
+        # timed-out stop() join must keep releasing the OLD semaphore,
+        # never a successor pool's (instance attrs are re-created on
+        # restart).
         while True:
-            batch = self._work.get()
+            batch = work.get()
             if batch is None:
                 return
             try:
                 self._flush(batch)
             finally:
-                self._inflight.release()
+                inflight.release()
 
     # -- caller side ------------------------------------------------------
 
@@ -217,6 +225,10 @@ class _BatchDispatcher:
     # -- collector --------------------------------------------------------
 
     def _collector(self) -> None:
+        # Local refs for the same reason as _flush_worker: a collector
+        # that outlives a timed-out stop() join must finish against the
+        # pool it started with.
+        inflight, work = self._inflight, self._work
         while True:
             with self._cv:
                 while self._running and not self._queue:
@@ -244,8 +256,18 @@ class _BatchDispatcher:
                 # pipeline is full, so submits keep coalescing into
                 # bigger batches — the same backpressure the serial
                 # collector had.
-                self._inflight.acquire()
-                self._work.put(batch)
+                inflight.acquire()
+                if not self._running:
+                    # stop() began while we waited for a permit; the
+                    # sentinels may already be queued ahead of this
+                    # batch.  Flush inline so these callers are served,
+                    # not stranded behind a drained pool.
+                    try:
+                        self._flush(batch)
+                    finally:
+                        inflight.release()
+                else:
+                    work.put(batch)
 
     def _flush(self, batch: list[_Pending]) -> None:
         flat = [it for p in batch for it in p.items]

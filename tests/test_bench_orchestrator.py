@@ -323,6 +323,11 @@ def test_headline_prefers_tpu_backed_section(bench, monkeypatch, capsys):
     compact, detail = _run_main(bench, capsys)
     assert compact["metric"] == "rsa2048_verifies_per_sec"
     assert compact["value"] == 550684.8
+    # Verify-rate headlines ratio against the per-replica verify
+    # requirement (2.2M/s) instead of reporting null.
+    assert compact["vs_baseline"] == round(
+        550684.8 / bench.NORTH_STAR_VERIFIES_PER_SEC, 5
+    )
     assert compact["extra"]["headline_from"] == "rns_kernel"
     # The CPU cluster number still rides along in the record.
     assert detail["extra"]["cluster_4"]["writes_per_sec"] == 6.72
