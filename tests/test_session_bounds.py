@@ -34,8 +34,18 @@ def test_auth_session_map_bounded():
         assert len(srv._auth) <= 16, len(srv._auth)
         for s in c.all_servers:
             assert len(s._auth) <= 4096
-        # The hottest entry still authenticates after the flood.
+        # The hottest entry still authenticates after the flood.  One
+        # bounded retry: the TPA handshake needs k-of-n live phases,
+        # and on a heavily loaded machine a replica can miss its slot
+        # in the first attempt (observed ~1 in 3 full-suite runs under
+        # contention); what this test pins is that eviction never
+        # *locks out* the variable, not single-shot scheduling luck.
         proof, _ = cl.authenticate(b"flood/27", b"pw-27")
+        if proof is None:
+            import time
+
+            time.sleep(0.5)
+            proof, _ = cl.authenticate(b"flood/27", b"pw-27")
         assert proof is not None
     finally:
         c.stop()
