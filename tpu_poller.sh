@@ -5,7 +5,10 @@
 # a mid-run tunnel death skipped (bench.py re-probes per section).
 cd /root/repo
 while true; do
-  if timeout 90 python - <<'PY' 2>/dev/null
+  # -k: the axon register() hang can shrug off SIGTERM; escalate to
+  # SIGKILL so a blackholed tunnel can't wedge the probe (observed as
+  # multi-minute gaps in this log).
+  if timeout -k 10 90 python - <<'PY' 2>/dev/null
 import jax
 assert jax.default_backend() != "cpu"
 PY
