@@ -140,3 +140,35 @@ def test_verify_rns_indexed_pallas_backend(monkeypatch):
         )
     )
     assert ok.tolist() == [True, True, False, True]
+
+
+def test_mosaic_lowering_for_tpu_target():
+    """The fused chains LOWER to Mosaic for a TPU target (jax.export
+    runs the pallas→Mosaic MLIR lowering on the host, no device
+    needed).  Interpret-mode tests cannot catch unsupported-op or
+    layout errors in that lowering; this pins the class of failure
+    that would otherwise only surface as the loud XLA fallback during
+    a live bench window (VERDICT r4 item 3)."""
+    import jax
+
+    # Verify chain at the production tile (2048-bit context).
+    pc = pallas_rns._pad_consts(128, 2048)
+    run = pallas_rns._verify_call(128, 2048, 256, False)
+    z = lambda w: jnp.zeros((256, w), jnp.float32)
+    exp = jax.export.export(run, platforms=("tpu",))(
+        z(256), z(256),
+        z(pc.kpad), z(pc.kpad), z(1), z(pc.kpad),
+        z(pc.kpad), z(pc.kpad), z(pc.kpad), z(pc.kpad), z(1),
+    )
+    assert len(exp.mlir_module_serialized) > 0
+
+    # Sign (pow) chain at the production tile (1024-bit CRT context).
+    pc2 = pallas_rns._pad_consts(64, 1024)
+    run2 = pallas_rns._pow_call(64, 1024, 256, False)
+    exp2 = jax.export.export(run2, platforms=("tpu",))(
+        jnp.zeros((256, 128), jnp.float32),   # base halves
+        jnp.zeros((256, 256), jnp.float32),   # nibbles (W, T)
+        z(pc2.kpad), z(pc2.kpad), z(1), z(pc2.kpad),
+        z(pc2.kpad), z(pc2.kpad), z(1),
+    )
+    assert len(exp2.mlir_module_serialized) > 0
