@@ -36,9 +36,36 @@ from jax.experimental.pallas import tpu as pltpu
 
 from bftkv_tpu.ops import rns
 
-__all__ = ["pow_pallas", "verify_pallas", "TILE"]
+__all__ = ["pow_pallas", "verify_pallas", "TILE_POW", "TILE_VERIFY"]
 
-TILE = 256  # batch rows per grid step
+import os as _os
+
+#: Batch rows per grid step.  Budgeted against ~16 MB VMEM/core:
+#: the pow chain (kpad=128) holds its 16-entry window table (~4 MB at
+#: tile 256) plus ~5 MB of key rows/consts/temps — comfortable at 256.
+#: The verify chain has no table but its kpad is 256 (k=188 channels)
+#: and it streams ELEVEN row-blocked inputs, each double-buffered by
+#: the Mosaic pipeline (~7 MB at tile 256 for inputs alone, plus ~4 MB
+#: consts and the live temporaries) — tight enough that tile 128 is
+#: the safe default; the first live-hardware measurement can raise it
+#: via env (BFTKV_PALLAS_TILE_VERIFY / _POW).
+def _tile_env(name: str, default: str) -> int:
+    """Validated tile size: a power of two ≥ 8 (TPU sublane multiple;
+    power-of-two so the callers' padded batches always divide it).
+    Fail fast at import — a bad knob must not surface as a deep Mosaic
+    error or a silent per-flush XLA fallback."""
+    raw = _os.environ.get(name, default)
+    try:
+        v = int(raw)
+    except ValueError:
+        raise ValueError(f"{name} must be an integer, got {raw!r}") from None
+    if v < 8 or (v & (v - 1)):
+        raise ValueError(f"{name} must be a power of two >= 8, got {v}")
+    return v
+
+
+TILE_POW = _tile_env("BFTKV_PALLAS_TILE_POW", "256")
+TILE_VERIFY = _tile_env("BFTKV_PALLAS_TILE_VERIFY", "128")
 PR = rns.PR
 _PRF = np.float32(PR)
 _INV_PRF = np.float32(1.0 / PR)
@@ -409,7 +436,7 @@ def pow_pallas(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     t = base_halves_u8.shape[0]
-    tile = min(TILE, t)
+    tile = min(TILE_POW, t)
     if t % tile:
         # grid = t // tile would silently drop the tail rows; in-repo
         # callers pad to powers of two, but this is a documented
@@ -520,7 +547,7 @@ def verify_pallas(
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     t = sig_halves_u8.shape[0]
-    tile = min(TILE, t)
+    tile = min(TILE_VERIFY, t)
     if t % tile:
         # Unwritten tail rows would be *uninitialized verdicts* — a
         # fail-open hazard.  Refuse; callers pad (rsa._verify_rns does).

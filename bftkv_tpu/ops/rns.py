@@ -672,7 +672,9 @@ def pallas_status() -> dict:
 def _pallas_proven_path(which: str) -> str:
     """Marker recording that fused chain ``which`` ("pow"/"verify")
     COMPLETED on real TPU for the current kernel sources + jax version
-    (hash of this file and pallas_rns.py).  Per-chain: a verify-only
+    (hash of this file and pallas_rns.py) at the current tile size —
+    tile is folded in because VMEM pressure scales with it: a proof at
+    tile 128 says nothing about tile 512.  Per-chain: a verify-only
     proof must not arm auto mode for a pow chain whose Mosaic compile
     fails on this hardware."""
     import hashlib
@@ -687,9 +689,12 @@ def _pallas_proven_path(which: str) -> str:
         except OSError:
             pass
     h.update(jax.__version__.encode())
+    tile = (
+        pallas_rns.TILE_POW if which == "pow" else pallas_rns.TILE_VERIFY
+    )
     cache = os.path.expanduser("~/.cache/jax_bftkv")
     return os.path.join(
-        cache, f"pallas_proven_{which}_{h.hexdigest()[:12]}"
+        cache, f"pallas_proven_{which}_t{tile}_{h.hexdigest()[:12]}"
     )
 
 

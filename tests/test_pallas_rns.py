@@ -152,9 +152,10 @@ def test_mosaic_lowering_for_tpu_target():
     import jax
 
     # Verify chain at the production tile (2048-bit context).
+    tv = pallas_rns.TILE_VERIFY
     pc = pallas_rns._pad_consts(128, 2048)
-    run = pallas_rns._verify_call(128, 2048, 256, False)
-    z = lambda w: jnp.zeros((256, w), jnp.float32)
+    run = pallas_rns._verify_call(128, 2048, tv, False)
+    z = lambda w: jnp.zeros((tv, w), jnp.float32)
     exp = jax.export.export(run, platforms=("tpu",))(
         z(256), z(256),
         z(pc.kpad), z(pc.kpad), z(1), z(pc.kpad),
@@ -163,12 +164,14 @@ def test_mosaic_lowering_for_tpu_target():
     assert len(exp.mlir_module_serialized) > 0
 
     # Sign (pow) chain at the production tile (1024-bit CRT context).
+    tp = pallas_rns.TILE_POW
     pc2 = pallas_rns._pad_consts(64, 1024)
-    run2 = pallas_rns._pow_call(64, 1024, 256, False)
+    run2 = pallas_rns._pow_call(64, 1024, tp, False)
+    zp = lambda w: jnp.zeros((tp, w), jnp.float32)
     exp2 = jax.export.export(run2, platforms=("tpu",))(
-        jnp.zeros((256, 128), jnp.float32),   # base halves
-        jnp.zeros((256, 256), jnp.float32),   # nibbles (W, T)
-        z(pc2.kpad), z(pc2.kpad), z(1), z(pc2.kpad),
-        z(pc2.kpad), z(pc2.kpad), z(1),
+        zp(128),                               # base halves
+        jnp.zeros((256, tp), jnp.float32),     # nibbles (W, T)
+        zp(pc2.kpad), zp(pc2.kpad), zp(1), zp(pc2.kpad),
+        zp(pc2.kpad), zp(pc2.kpad), zp(1),
     )
     assert len(exp2.mlir_module_serialized) > 0
