@@ -1115,6 +1115,11 @@ def _run_child(token: str, timeout_s: float, force_cpu: bool):
     env = dict(os.environ)
     if force_cpu:
         env["BENCH_FORCE_CPU"] = "1"
+        # A CPU child must start even when the accelerator tunnel
+        # blackholes: the ambient sitecustomize dials the tunnel at
+        # interpreter start when this var is set, and the hang would
+        # eat the whole section budget before our code runs.
+        env["PALLAS_AXON_POOL_IPS"] = ""
     else:
         env.pop("BENCH_FORCE_CPU", None)
     with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as tf:
