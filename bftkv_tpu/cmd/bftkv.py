@@ -264,6 +264,15 @@ def main(argv: list[str] | None = None) -> int:
                          "certificate address)")
     ap.add_argument("--join", action="store_true",
                     help="crawl the trust graph at startup")
+    ap.add_argument("--anti-entropy", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="background replica state-sync interval "
+                         "(jittered; 0 disables). Each round pulls "
+                         "digests from f+1 distinct peers and admits "
+                         "divergent records only through the full "
+                         "local admission path — a restarted or "
+                         "lagging replica converges without client "
+                         "traffic (bftkv_tpu/sync)")
     ap.add_argument("--dispatch", action="store_true",
                     help="install the TPU verify/sign dispatchers "
                          "(one replica process per accelerator)")
@@ -333,6 +342,15 @@ def main(argv: list[str] | None = None) -> int:
     )
     print(f"bftkv: serving {graph.name} @ {where}", flush=True)
 
+    sync_daemon = None
+    if args.anti_entropy > 0:
+        from bftkv_tpu.sync import SyncDaemon
+
+        sync_daemon = SyncDaemon(server, interval=args.anti_entropy).start()
+        print(
+            f"bftkv: anti-entropy every ~{args.anti_entropy:g}s", flush=True
+        )
+
     from bftkv_tpu.protocol.client import Client
 
     if args.client_home:
@@ -375,6 +393,8 @@ def main(argv: list[str] | None = None) -> int:
         os.replace(tmp, args.revlist)
     if api_httpd is not None:
         api_httpd.shutdown()
+    if sync_daemon is not None:
+        sync_daemon.stop()
     server.stop()
     if hasattr(server.storage, "close"):
         server.storage.close()

@@ -44,6 +44,7 @@ def spawn(
     join: bool = False,
     client_home: str = "",
     verify_sidecar: str = "",
+    anti_entropy: float = 0.0,
     extra_env: dict | None = None,
 ) -> list[subprocess.Popen]:
     """``verify_sidecar``: "auto" spawns one shared sidecar process and
@@ -91,6 +92,8 @@ def spawn(
             cmd += ["--join"]
         if verify_sidecar:
             cmd += ["--verify-sidecar", verify_sidecar]
+        if anti_entropy > 0:
+            cmd += ["--anti-entropy", str(anti_entropy)]
         procs.append(subprocess.Popen(cmd, env=env))
     return procs
 
@@ -125,6 +128,10 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--verify-sidecar", default="",
                     help='"auto" spawns one shared verification sidecar '
                          "for the fleet; or host:port of an existing one")
+    ap.add_argument("--anti-entropy", type=float, default=0.0,
+                    metavar="SECONDS",
+                    help="per-daemon background state-sync interval "
+                         "(jittered; 0 disables — see bftkv --help)")
     args = ap.parse_args(argv)
 
     homes = server_homes(args.keys)
@@ -134,7 +141,8 @@ def main(argv: list[str] | None = None) -> int:
     procs = spawn(homes, args.db_root, storage=args.storage,
                   api_base=args.api_base, api_host=args.api_host,
                   bind_host=args.bind_host, client_home=args.client_home,
-                  verify_sidecar=args.verify_sidecar)
+                  verify_sidecar=args.verify_sidecar,
+                  anti_entropy=args.anti_entropy)
     # The sidecar (if spawned, always first) is an optional optimizer
     # whose clients fall back to local verification: its death must not
     # tear down the replica fleet, and it is not a "server".

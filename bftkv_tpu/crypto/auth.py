@@ -39,9 +39,8 @@ import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-
 from bftkv_tpu.crypto import sss
+from bftkv_tpu.crypto.aead import AESGCM
 from bftkv_tpu.errors import (
     ERR_AUTHENTICATION_FAILURE,
     ERR_DECRYPTION_FAILURE,

@@ -12,8 +12,7 @@ from __future__ import annotations
 import hashlib
 import os
 
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-
+from bftkv_tpu.crypto.aead import AESGCM
 from bftkv_tpu.errors import ERR_DECRYPTION_FAILURE
 
 _INFO = b"bftkv_tpu data encryption v1"

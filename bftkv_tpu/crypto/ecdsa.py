@@ -301,7 +301,7 @@ def _kdf(shared: bytes, eph_pub: bytes, recip_pub: bytes) -> bytes:
 
 def ecies_wrap(secret: bytes, recipient: ECPublicKey) -> bytes:
     """eph_pub(65) ‖ gcm_nonce(12) ‖ GCM(kdf(ecdh), secret)."""
-    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    from bftkv_tpu.crypto.aead import AESGCM
 
     curve = recipient.curve
     eph = generate(curve)
@@ -315,7 +315,7 @@ def ecies_wrap(secret: bytes, recipient: ECPublicKey) -> bytes:
 
 def ecies_unwrap(blob: bytes, key: ECPrivateKey) -> bytes:
     """Inverse of :func:`ecies_wrap`; raises on any mismatch."""
-    from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+    from bftkv_tpu.crypto.aead import AESGCM
 
     curve = key.curve
     plen = 1 + 2 * ((curve.bits + 7) // 8)

@@ -48,19 +48,17 @@ Wire formats:
 
 from __future__ import annotations
 
+import hashlib
 import io
 import os
 import struct
 import threading
 from collections import OrderedDict
 
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import padding as _padding
-from cryptography.hazmat.primitives.asymmetric import rsa as _crsa
-from cryptography.hazmat.primitives.ciphers.aead import AESGCM
-
 from bftkv_tpu.crypto import cert as certmod
 from bftkv_tpu.crypto import rsa
+from bftkv_tpu.crypto.aead import AESGCM
+from bftkv_tpu.crypto.aead import _xor as _bxor
 from bftkv_tpu.errors import (
     ERR_DECRYPTION_FAILURE,
     ERR_INVALID_SIGNATURE,
@@ -69,11 +67,26 @@ from bftkv_tpu.errors import (
 )
 from bftkv_tpu.packet import read_chunk, write_chunk
 
-_OAEP = _padding.OAEP(
-    mgf=_padding.MGF1(algorithm=hashes.SHA256()),
-    algorithm=hashes.SHA256(),
-    label=None,
-)
+# The host ``cryptography`` library accelerates the RSA-OAEP key wrap
+# when present; without it (the jax_graft image does not bake it in)
+# the pure-Python RFC 8017 OAEP below carries the bootstrap path —
+# byte-compatible on the wire, it is the same OAEP(SHA-256).
+try:  # pragma: no cover - branch depends on the host image
+    from cryptography.hazmat.primitives import hashes as _hashes
+    from cryptography.hazmat.primitives.asymmetric import padding as _padding
+    from cryptography.hazmat.primitives.asymmetric import rsa as _crsa
+
+    _OAEP = _padding.OAEP(
+        mgf=_padding.MGF1(algorithm=_hashes.SHA256()),
+        algorithm=_hashes.SHA256(),
+        label=None,
+    )
+except Exception:
+    # Same-stack requirement as the AEAD seam (crypto/aead.py logs the
+    # downgrade once); the pure path IS byte-compatible OAEP, so this
+    # one only changes speed, not the wire.
+    _crsa = None
+    _OAEP = None
 
 _TAG_BOOTSTRAP = 0x01
 _TAG_SESSION = 0x02
@@ -97,13 +110,65 @@ def _private(key: rsa.PrivateKey):
     ).private_key()
 
 
+# -- pure-Python RSA-OAEP(SHA-256) fallback (RFC 8017 §7.1) ----------------
+
+_HLEN = 32
+_LHASH = hashlib.sha256(b"").digest()
+
+
+def _mgf1(seed: bytes, n: int) -> bytes:
+    out = b""
+    for i in range((n + _HLEN - 1) // _HLEN):
+        out += hashlib.sha256(seed + struct.pack(">I", i)).digest()
+    return out[:n]
+
+
+def _oaep_wrap_py(n: int, e: int, secret: bytes) -> bytes:
+    k = (n.bit_length() + 7) // 8
+    if len(secret) > k - 2 * _HLEN - 2:
+        raise ValueError("oaep: message too long")
+    ps = b"\x00" * (k - len(secret) - 2 * _HLEN - 2)
+    db = _LHASH + ps + b"\x01" + secret
+    seed = os.urandom(_HLEN)
+    masked_db = _bxor(db, _mgf1(seed, k - _HLEN - 1))
+    masked_seed = _bxor(seed, _mgf1(masked_db, _HLEN))
+    em = int.from_bytes(b"\x00" + masked_seed + masked_db, "big")
+    return pow(em, e, n).to_bytes(k, "big")
+
+
+def _oaep_unwrap_py(key: rsa.PrivateKey, blob: bytes) -> bytes:
+    k = (key.n.bit_length() + 7) // 8
+    c = int.from_bytes(blob, "big")
+    if len(blob) != k or c >= key.n:
+        raise ValueError("oaep: malformed ciphertext")
+    # CRT decrypt, ~4x a straight pow on host.
+    m1 = pow(c, key.d % (key.p - 1), key.p)
+    m2 = pow(c, key.d % (key.q - 1), key.q)
+    h = (pow(key.q, -1, key.p) * (m1 - m2)) % key.p
+    em = (m2 + h * key.q).to_bytes(k, "big")
+    masked_seed, masked_db = em[1 : 1 + _HLEN], em[1 + _HLEN :]
+    seed = _bxor(masked_seed, _mgf1(masked_db, _HLEN))
+    db = _bxor(masked_db, _mgf1(seed, k - _HLEN - 1))
+    sep = db.find(b"\x01", _HLEN)
+    if (
+        em[0] != 0
+        or db[:_HLEN] != _LHASH
+        or sep < 0
+        or any(db[_HLEN:sep])
+    ):
+        raise ValueError("oaep: decoding error")
+    return db[sep + 1 :]
+
+
 def _wrap_to(c: certmod.Certificate, secret: bytes) -> bytes:
     """Key-wrap ``secret`` to a peer in the peer's own algorithm:
     RSA-OAEP(SHA-256) for RSA certs, ECIES (ephemeral ECDH + HKDF +
     AES-GCM) for P-256 certs.  The recipient knows its own key type, so
     no wire tag is needed."""
     if c.alg == certmod.ALG_RSA:
-        return _public(c).encrypt(secret, _OAEP)
+        if _crsa is not None:
+            return _public(c).encrypt(secret, _OAEP)
+        return _oaep_wrap_py(c.n, c.e, secret)
     from bftkv_tpu.crypto import ecdsa as _ecdsa
 
     return _ecdsa.ecies_wrap(secret, c.public_key)
@@ -140,7 +205,9 @@ class MessageSecurity:
         self.key = key
         self.cert = certificate
         self._is_ec = certmod.is_ec(key)
-        self._priv = None if self._is_ec else _private(key)
+        self._priv = (
+            None if self._is_ec or _crsa is None else _private(key)
+        )
         self._lock = threading.Lock()
         # peer id -> _SessionOut (how I encrypt *to* that peer)
         self._by_peer: "OrderedDict[int, _SessionOut]" = OrderedDict()
@@ -400,7 +467,9 @@ class MessageSecurity:
             from bftkv_tpu.crypto import ecdsa as _ecdsa
 
             return _ecdsa.ecies_unwrap(wrapped, self.key)
-        return self._priv.decrypt(wrapped, _OAEP)
+        if self._priv is not None:
+            return self._priv.decrypt(wrapped, _OAEP)
+        return _oaep_unwrap_py(self.key, wrapped)
 
     def _accept_grant(self, grant_bytes: bytes, sender) -> None:
         """Install the session granted to *me* (if any). Grants are

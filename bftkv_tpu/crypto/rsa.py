@@ -60,10 +60,20 @@ class PrivateKey:
 
 
 def generate(bits: int = 2048) -> PrivateKey:
-    """Generate an RSA key (host-side setup path; uses the system
-    cryptography library's generator)."""
-    from cryptography.hazmat.primitives.asymmetric import rsa as _rsa
+    """Generate an RSA key (host-side setup path).
 
+    Provider chain: the host ``cryptography`` library when installed,
+    the ``openssl`` CLI otherwise (the jax_graft image bakes in the
+    binary but not the Python package), and a pure-Python
+    Miller–Rabin generator as the last resort — setup-path only, never
+    on a hot path."""
+    try:
+        from cryptography.hazmat.primitives.asymmetric import rsa as _rsa
+    except Exception:
+        try:
+            return _generate_openssl(bits)
+        except Exception:
+            return _generate_py(bits)
     key = _rsa.generate_private_key(public_exponent=F4, key_size=bits)
     pn = key.private_numbers()
     return PrivateKey(
@@ -73,6 +83,132 @@ def generate(bits: int = 2048) -> PrivateKey:
         p=pn.p,
         q=pn.q,
     )
+
+
+# -- dependency-free key generation (fallback providers) -------------------
+
+
+def _der_ints(data: bytes) -> list[int]:
+    """INTEGERs of one DER SEQUENCE (flat walk; enough for PKCS#1
+    RSAPrivateKey and PKCS#8 unwrapping below)."""
+    if not data or data[0] != 0x30:
+        raise ValueError("der: not a SEQUENCE")
+    body, _ = _der_tlv(data, 0)
+    out: list[int] = []
+    off = 0
+    while off < len(body):
+        tag = body[off]
+        val, off = _der_tlv(body, off)
+        if tag == 0x02:
+            out.append(int.from_bytes(val, "big"))
+    return out
+
+
+def _der_tlv(data: bytes, off: int) -> tuple[bytes, int]:
+    """Value bytes of the TLV at ``off`` plus the offset just past it."""
+    if off + 2 > len(data):
+        raise ValueError("der: truncated")
+    length = data[off + 1]
+    off += 2
+    if length & 0x80:
+        nlen = length & 0x7F
+        if nlen == 0 or off + nlen > len(data):
+            raise ValueError("der: bad length")
+        length = int.from_bytes(data[off : off + nlen], "big")
+        off += nlen
+    if off + length > len(data):
+        raise ValueError("der: truncated value")
+    return data[off : off + length], off + length
+
+
+def _pem_der(pem: bytes, marker: bytes) -> bytes:
+    import base64
+
+    start = pem.index(b"-----BEGIN " + marker + b"-----")
+    end = pem.index(b"-----END " + marker + b"-----")
+    b64 = b"".join(pem[start:end].splitlines()[1:])
+    return base64.b64decode(b64)
+
+
+def _generate_openssl(bits: int) -> PrivateKey:
+    import subprocess
+
+    pem = subprocess.run(
+        ["openssl", "genrsa", str(bits)],
+        capture_output=True,
+        check=True,
+        timeout=120,
+    ).stdout
+    if b"BEGIN RSA PRIVATE KEY" in pem:  # PKCS#1 (openssl 1.x)
+        der = _pem_der(pem, b"RSA PRIVATE KEY")
+    else:  # PKCS#8 (openssl 3.x): the key rides in an OCTET STRING
+        der = _pem_der(pem, b"PRIVATE KEY")
+        body, _ = _der_tlv(der, 0)
+        off = 0
+        while off < len(body):
+            tag = body[off]
+            val, off = _der_tlv(body, off)
+            if tag == 0x04:
+                der = val
+                break
+        else:
+            raise ValueError("pkcs8: no key octet string")
+    # RSAPrivateKey ::= SEQUENCE { version, n, e, d, p, q, dP, dQ, qInv }
+    ints = _der_ints(der)
+    if len(ints) < 6:
+        raise ValueError("pkcs1: short key")
+    _v, n, e, d, p, q = ints[:6]
+    return PrivateKey(n=n, e=e, d=d, p=p, q=q)
+
+
+def _is_probable_prime(n: int, rounds: int = 40) -> bool:
+    import secrets
+
+    if n < 2:
+        return False
+    for sp in (2, 3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 37, 41, 43, 47):
+        if n % sp == 0:
+            return n == sp
+    d, r = n - 1, 0
+    while d % 2 == 0:
+        d //= 2
+        r += 1
+    for _ in range(rounds):
+        a = secrets.randbelow(n - 3) + 2
+        x = pow(a, d, n)
+        if x in (1, n - 1):
+            continue
+        for _ in range(r - 1):
+            x = x * x % n
+            if x == n - 1:
+                break
+        else:
+            return False
+    return True
+
+
+def _gen_prime(bits: int, avoid: int = 0) -> int:
+    import secrets
+
+    while True:
+        p = secrets.randbits(bits) | (1 << (bits - 1)) | (1 << (bits - 2)) | 1
+        if p != avoid and p % F4 != 1 and _is_probable_prime(p):
+            return p
+
+
+def _generate_py(bits: int) -> PrivateKey:
+    while True:
+        p = _gen_prime(bits // 2)
+        q = _gen_prime(bits - bits // 2, avoid=p)
+        n = p * q
+        if n.bit_length() != bits:
+            continue
+        phi = (p - 1) * (q - 1)
+        try:
+            d = pow(F4, -1, phi)
+        except ValueError:
+            continue
+        return PrivateKey(n=n, e=F4, d=d, p=p, q=q)
 
 
 def emsa_pkcs1v15_sha256(message: bytes, em_len: int) -> int:

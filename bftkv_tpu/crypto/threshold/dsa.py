@@ -30,14 +30,40 @@ class DSAPrivateKey:
 
 
 def generate(key_size: int = 2048) -> DSAPrivateKey:
-    """FFC parameter + key generation via the host crypto library."""
-    from cryptography.hazmat.primitives.asymmetric import dsa as _cdsa
+    """FFC parameter + key generation: host crypto library when
+    installed, the ``openssl`` CLI otherwise (setup path only)."""
+    try:
+        from cryptography.hazmat.primitives.asymmetric import dsa as _cdsa
+    except Exception:
+        return _generate_openssl(key_size)
 
     k = _cdsa.generate_private_key(key_size)
     nums = k.private_numbers()
     pub = nums.public_numbers
     par = pub.parameter_numbers
     return DSAPrivateKey(p=par.p, q=par.q, g=par.g, x=nums.x, y=pub.y)
+
+
+def _generate_openssl(key_size: int) -> DSAPrivateKey:
+    """``openssl dsaparam`` FFC params + our own x/y.
+
+    Dss-Parms ::= SEQUENCE { p, q, g } — parsed with the same minimal
+    DER reader the RSA fallback uses."""
+    import secrets
+    import subprocess
+
+    from bftkv_tpu.crypto import rsa as _rsa
+
+    pem = subprocess.run(
+        ["openssl", "dsaparam", str(key_size)],
+        capture_output=True,
+        check=True,
+        timeout=300,
+    ).stdout
+    der = _rsa._pem_der(pem, b"DSA PARAMETERS")
+    p, q, g = _rsa._der_ints(der)[:3]
+    x = secrets.randbelow(q - 1) + 1
+    return DSAPrivateKey(p=p, q=q, g=g, x=x, y=pow(g, x, p))
 
 
 class _DSAGroupOps:

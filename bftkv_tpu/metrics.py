@@ -22,6 +22,11 @@ class Metrics:
         self._counters: dict[str, int] = defaultdict(int)
         self._sums: dict[str, float] = defaultdict(float)
         self._samples: dict[str, list[float]] = defaultdict(list)
+        # Ring-buffer write cursors: the histogram must keep admitting
+        # values forever.  The old append-until-full behavior froze each
+        # series at its first 65536 samples, so a daemon's p50/p99
+        # reported startup behavior for the rest of its life.
+        self._sample_pos: dict[str, int] = defaultdict(int)
         self._max_samples = 65536
 
     def incr(self, name: str, n: int = 1) -> None:
@@ -29,13 +34,22 @@ class Metrics:
             self._counters[name] += n
 
     def observe(self, name: str, value: float) -> None:
-        """Record one sample (latency seconds, batch size, ...)."""
+        """Record one sample (latency seconds, batch size, ...).
+
+        Samples land in a per-series ring buffer: totals (`.count` /
+        `.sum`) cover the whole run while percentiles reflect the most
+        recent ``_max_samples`` window."""
         with self._lock:
             self._counters[name + ".count"] += 1
             self._sums[name + ".sum"] += value
             s = self._samples[name]
             if len(s) < self._max_samples:
                 s.append(value)
+            else:
+                s[self._sample_pos[name]] = value
+                self._sample_pos[name] = (
+                    self._sample_pos[name] + 1
+                ) % self._max_samples
 
     class _Timer:
         def __init__(self, m: "Metrics", name: str):
@@ -64,11 +78,12 @@ class Metrics:
         with self._lock:
             out: dict = dict(self._counters)
             out.update(self._sums)
-        for name in list(self._samples):
+            # Copy the series under the lock: concurrent observe() of a
+            # *new* name would otherwise mutate the dict mid-iteration.
+            series = {n: sorted(s) for n, s in self._samples.items() if s}
+        for name, s in series.items():
             for q, tag in ((0.5, "p50"), (0.99, "p99")):
-                v = self.percentile(name, q)
-                if v is not None:
-                    out[f"{name}.{tag}"] = v
+                out[f"{name}.{tag}"] = s[min(len(s) - 1, int(q * len(s)))]
         return out
 
     def reset(self) -> None:
@@ -76,6 +91,7 @@ class Metrics:
             self._counters.clear()
             self._sums.clear()
             self._samples.clear()
+            self._sample_pos.clear()
 
 
 registry = Metrics()

@@ -352,6 +352,54 @@ def parse_results(data: bytes) -> list[tuple[str | None, bytes]]:
     return out
 
 
+# -- anti-entropy codecs (bftkv_tpu/sync; no reference analog) -------------
+# A digest is the non-empty buckets of a replica's keyspace digest tree:
+# count-prefixed entries of ``bucket_id(1) | bucket_hash(32)``.  A pull
+# request names bucket ids (one byte each); a pull response is a
+# count-prefixed list of raw stored records (full packets).  All three
+# ride the existing list codec so the C fast path applies.
+
+DIGEST_HASH_LEN = 32
+
+
+def serialize_digest(buckets: dict[int, bytes]) -> bytes:
+    items = [
+        bytes([b]) + h for b, h in sorted(buckets.items()) if h is not None
+    ]
+    return serialize_list(items)
+
+
+def parse_digest(data: bytes) -> dict[int, bytes]:
+    """Inverse of :func:`serialize_digest`.  Entries of the wrong shape
+    are a protocol error — digests come from untrusted peers, and a
+    torn entry must not silently alias an empty bucket."""
+    out: dict[int, bytes] = {}
+    items = parse_list(data)
+    if len(items) > 256:
+        raise ERR_MALFORMED_REQUEST
+    for it in items:
+        if len(it) != 1 + DIGEST_HASH_LEN:
+            raise ERR_MALFORMED_REQUEST
+        out[it[0]] = it[1:]
+    return out
+
+
+def serialize_bucket_ids(ids: list[int]) -> bytes:
+    return serialize_list([bytes([b]) for b in ids])
+
+
+def parse_bucket_ids(data: bytes) -> list[int]:
+    out = []
+    items = parse_list(data)
+    if len(items) > 256:
+        raise ERR_MALFORMED_REQUEST
+    for it in items:
+        if len(it) != 1:
+            raise ERR_MALFORMED_REQUEST
+        out.append(it[0])
+    return out
+
+
 def write_bigint(buf: io.BytesIO, n: int | None) -> None:
     """(reference: packet/packet.go:288-294)"""
     if n is None:

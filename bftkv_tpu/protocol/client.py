@@ -607,10 +607,20 @@ class Client(Protocol):
     # -- read path (reference: client.go:189-353) -------------------------
 
     def read(self, variable: bytes, proof=None) -> bytes | None:
-        """Quorum read.  Returns as soon as some value reaches threshold
-        at the maximum timestamp; the fan-out keeps running on a worker
-        thread to finish revoke-on-read and read-repair
-        (reference: client.go:237-279)."""
+        """Quorum read, resolved over the COMPLETE fan-out; the worker
+        thread finishes revoke-on-read and read-repair
+        (reference: client.go:237-279 returns at first threshold).
+
+        Divergence — deterministic resolution (the batch path's round-4
+        fix, DESIGN.md §3.3, now applied to the single path too):
+        freezing at the first threshold made the winner arrival-order
+        dependent — a committed newest write with a single honest
+        holder lost to a stale threshold whenever its response arrived
+        late, so the same read could return either value under load.
+        Resolving over the complete fan-out costs the early-exit
+        latency but makes the outcome a function of the response SET,
+        with the lone signed newest verified cryptographically
+        (``_resolve_complete_fanout_many``)."""
         with metrics.timer("client.read.latency"):
             q = self.qs.choose_quorum(qm.READ)
             req = pkt.serialize(variable, None, 0, None, proof)
@@ -644,21 +654,14 @@ class Client(Protocol):
                 ch.put((val, err))
 
         def cb(res: tp.MulticastResponse) -> bool:
-            nonlocal value, maxt
             err = self._process_response(res, m, variable)
-            if err is None:
-                if not done:
-                    try:
-                        value, maxt = self._max_timestamped_value(m, q)
-                        deliver(value, None)
-                    except _InProgress:
-                        pass
-                    except Exception as e:
-                        deliver(None, e)
-            else:
+            if err is not None:
                 failure.append(res.peer)
                 errs.append(err)
                 if not done and q.reject(failure):
+                    # Fast-fail stays: rejection is monotone in the
+                    # failure set, so it cannot flip with more
+                    # responses the way a value resolution can.
                     deliver(
                         None,
                         majority_error(
@@ -669,8 +672,10 @@ class Client(Protocol):
 
         self.tr.multicast(tp.READ, q.nodes(), req, cb)
         if not done:
-            # Complete fan-out: fall back past fabricated lone high-t
-            # buckets (see _resolve_complete_fanout_many).
+            # Deterministic resolution over the complete response set:
+            # threshold winner at the highest t, unless a *verified*
+            # collective signature endorses a strictly newer candidate
+            # (see _resolve_complete_fanout_many).
             try:
                 (res0,) = self._resolve_complete_fanout_many([m], q)
                 if res0 is not None:

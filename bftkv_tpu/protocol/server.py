@@ -84,6 +84,29 @@ class Server(Protocol):
         self._auth_used: dict[bytes, float] = {}
         self._auth_attempts: "OrderedDict[bytes, int]" = OrderedDict()
         self._auth_lock = threading.Lock()
+        # Anti-entropy digest tree (bftkv_tpu/sync), built lazily on the
+        # first SYNC_DIGEST/SYNC_PULL; every persist marks it dirty so
+        # digests stay incremental.
+        self._sync = None
+        self._sync_lock = threading.Lock()
+
+    # -- anti-entropy plumbing (bftkv_tpu/sync) ---------------------------
+
+    def _persist(self, variable: bytes, t: int, data: bytes) -> None:
+        """All handler writes go through here: storage write + digest
+        invalidation for the anti-entropy plane."""
+        self.storage.write(variable, t, data)
+        tree = self._sync
+        if tree is not None:
+            tree.mark(variable)
+
+    def _sync_tree(self):
+        with self._sync_lock:
+            if self._sync is None:
+                from bftkv_tpu.sync.digest import DigestTree
+
+                self._sync = DigestTree(self.storage)
+            return self._sync
 
     # -- lifecycle (reference: server.go:47-62) ---------------------------
 
@@ -270,7 +293,7 @@ class Server(Protocol):
         # Persist the request *without* ss — marks the write in-progress
         # (reference: server.go:275-281).
         stored = pkt.serialize(variable, val, t, sig, None, proof)
-        self.storage.write(variable, t, stored)
+        self._persist(variable, t, stored)
         metrics.incr("server.sign.ok")
         return res
 
@@ -392,7 +415,7 @@ class Server(Protocol):
         )
 
         out = self._write_storage_checks(variable, val, t, sig, ss, req)
-        self.storage.write(variable, t, out)
+        self._persist(variable, t, out)
         metrics.incr("server.write.ok")
         return None
 
@@ -495,7 +518,7 @@ class Server(Protocol):
                 raise ERR_EXIST  # can't overwrite the password
         except ERR_NOT_FOUND:
             pass
-        self.storage.write(variable, 0, req)
+        self._persist(variable, 0, req)
         return None
 
     #: Bounds on the per-variable AuthServer map: hard LRU cap plus an
@@ -656,7 +679,7 @@ class Server(Protocol):
         except ERR_NOT_FOUND:
             pass
         stored = pkt.serialize(variable, value, t, sig, ss, rauth)
-        self.storage.write(variable, t, stored)
+        self._persist(variable, t, stored)
         return ret
 
     # -- distributed crypto (reference: server.go:516-541) ----------------
@@ -686,6 +709,68 @@ class Server(Protocol):
 
     def _notify(self, req: bytes, peer, sender) -> bytes | None:
         return None  # no-op, as in the reference
+
+    # -- anti-entropy (no reference analog; bftkv_tpu/sync) ---------------
+
+    #: Bounds on one SYNC_PULL response — record count AND bytes (the
+    #: native backend stores multi-MB values, so a count cap alone
+    #: still allowed multi-GB replies).  A puller missing more simply
+    #: re-pulls next round.
+    SYNC_PULL_MAX = 8192
+    SYNC_PULL_MAX_BYTES = 32 << 20
+
+    def _require_sync_peer(self, peer) -> None:
+        """Sync serves keyring-known peers only.
+
+        Defense in depth, NOT the confidentiality boundary: open Join
+        enrollment registers first-contact certificates (the web-of-
+        trust model), so keyring membership is attacker-satisfiable.
+        Confidentiality comes from the plane's content rule instead —
+        TPA-protected records never enter digests or pulls at all
+        (sync/digest.py ``latest_completed``); everything served here
+        is what an anonymous quorum READ would serve anyway."""
+        if peer is None:
+            raise ERR_PERMISSION_DENIED
+
+    def _sync_digest(self, req: bytes, peer, sender) -> bytes:
+        """Serve the keyspace digest tree (bucket → rolling hash over
+        completed records)."""
+        self._require_sync_peer(peer)
+        return self._sync_tree().serialize()
+
+    def _sync_pull(self, req: bytes, peer, sender) -> bytes:
+        """Stream the latest completed record of every variable in the
+        requested buckets.  The puller re-runs full admission on each —
+        nothing served here carries authority."""
+        from bftkv_tpu.sync.digest import latest_completed
+
+        self._require_sync_peer(peer)
+        tree = self._sync_tree()
+        records: list[bytes] = []
+        total = 0
+        for b in pkt.parse_bucket_ids(req):
+            for variable in tree.bucket_variables(b):
+                if (
+                    len(records) >= self.SYNC_PULL_MAX
+                    or total >= self.SYNC_PULL_MAX_BYTES
+                ):
+                    break
+                rec = latest_completed(self.storage, variable)
+                if rec is None:
+                    continue
+                raw = rec[1]
+                if len(raw) > self.SYNC_PULL_MAX_BYTES:
+                    # An oversized record would blow the puller's reply
+                    # cap and be discarded wholesale — re-shipping it
+                    # every round would be a convergence livelock, so
+                    # it simply never syncs (read-repair still covers
+                    # it, like everything did in the reference).
+                    metrics.incr("server.sync_pull.oversized")
+                    continue
+                records.append(raw)
+                total += len(raw)
+        metrics.incr("server.sync_pull.records", len(records))
+        return pkt.serialize_list(records)
 
     # -- batch pipeline (no reference analog; see transport command doc) --
 
@@ -855,7 +940,7 @@ class Server(Protocol):
             if not sig.cert and self.crypt.keyring.get(issuer.id) is None:
                 sig.cert = issuer.serialize()
             stored = pkt.serialize(variable, val, t, sig, None, proof)
-            self.storage.write(variable, t, stored)
+            self._persist(variable, t, stored)
             tbss_list.append(pkt.tbss(r))
             tbss_idx.append(i)
 
@@ -945,7 +1030,7 @@ class Server(Protocol):
             except Exception as e:
                 results[i] = (_errstr(e), b"")
                 continue
-            self.storage.write(variable, t, out)
+            self._persist(variable, t, out)
             metrics.incr("server.write.ok")
             results[i] = (None, b"")
 
@@ -971,6 +1056,8 @@ class Server(Protocol):
         tp.BATCH_SIGN: "_batch_sign",
         tp.BATCH_WRITE: "_batch_write",
         tp.BATCH_READ: "_batch_read",
+        tp.SYNC_DIGEST: "_sync_digest",
+        tp.SYNC_PULL: "_sync_pull",
     }
 
 

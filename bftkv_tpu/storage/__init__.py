@@ -54,3 +54,22 @@ class Storage(Protocol):
         incomplete write-once record.
         """
         ...
+
+    def keys(self) -> list[bytes]:
+        """Every stored variable, each exactly once (any order).
+
+        The keyspace-enumeration half of the anti-entropy contract
+        (``bftkv_tpu.sync``): a replica's digest tree is computed from
+        ``keys()`` × ``versions()`` × ``read()``.  The reference has no
+        analog — its repair plane is client read-repair only — so this
+        is a genuine contract extension all three backends implement.
+        """
+        ...
+
+    def scan(self) -> list[tuple[bytes, int]]:
+        """Every stored ``(variable, t)`` pair (any order) — the full
+        version inventory in one call, for digest builds and
+        differential backend tests.  Equivalent to
+        ``[(v, t) for v in keys() for t in versions(v)]`` but a backend
+        may implement it with one index walk."""
+        ...

@@ -40,6 +40,20 @@ class MemStorage:
             entry = self._data.get(variable)
             return list(entry[0]) if entry else []
 
+    def keys(self) -> list[bytes]:
+        """Every stored variable (storage contract — anti-entropy)."""
+        with self._lock:
+            return list(self._data)
+
+    def scan(self) -> list[tuple[bytes, int]]:
+        """Every stored ``(variable, t)`` pair, one index walk."""
+        with self._lock:
+            return [
+                (var, t)
+                for var, (ts, _values) in self._data.items()
+                for t in ts
+            ]
+
     def write(self, variable: bytes, t: int, value: bytes) -> None:
         with self._lock:
             entry = self._data.get(variable)

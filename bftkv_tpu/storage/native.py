@@ -66,6 +66,12 @@ def _load() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_uint64),
             ctypes.c_uint64,
         ]
+        lib.kv_keys.restype = ctypes.c_int64
+        lib.kv_keys.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
         _lib = lib
         return lib
 
@@ -134,6 +140,39 @@ class NativeStorage:
                 if n <= cap:
                     return list(buf[: int(n)])
                 cap = int(n)
+
+    def keys(self) -> list[bytes]:
+        """Every stored variable (storage contract — anti-entropy):
+        length-prefixed names out of the C index, two-call sizing like
+        :meth:`versions`."""
+        with self._lock:
+            if not self._handle:
+                return []
+            cap = 0
+            buf = None
+            while True:
+                n = self._lib.kv_keys(self._handle, buf, cap)
+                if n < 0:
+                    return []
+                if n <= cap:
+                    break
+                # A concurrent write may grow the index between the
+                # sizing and filling calls; loop until it fits.
+                cap = int(n)
+                buf = ctypes.create_string_buffer(cap)
+            out: list[bytes] = []
+            data = buf.raw[: int(n)] if buf is not None else b""
+            off = 0
+            while off + 4 <= len(data):
+                ln = int.from_bytes(data[off : off + 4], "little")
+                off += 4
+                out.append(data[off : off + ln])
+                off += ln
+            return out
+
+    def scan(self) -> list[tuple[bytes, int]]:
+        """Every stored ``(variable, t)`` pair."""
+        return [(var, t) for var in self.keys() for t in self.versions(var)]
 
     def write(self, variable: bytes, t: int, value: bytes) -> None:
         with self._lock:

@@ -65,7 +65,19 @@ class PlainStorage:
 
     def write(self, variable: bytes, t: int, value: bytes) -> None:
         with self._lock:
-            fn = os.path.join(self.path, f"{self._prefix(variable)}.{t}")
+            stem = self._prefix(variable)
+            if stem.startswith("h"):
+                # Hash-stemmed long variable: the name is one-way, so
+                # keys() needs a sidecar holding the raw bytes.  ".k"
+                # never parses as a version (int("k") fails) and the
+                # write is atomic like the data files'.
+                kf = os.path.join(self.path, stem + ".k")
+                if not os.path.exists(kf):
+                    tmp = kf + ".tmp"
+                    with open(tmp, "wb") as f:
+                        f.write(variable)
+                    os.replace(tmp, kf)
+            fn = os.path.join(self.path, f"{stem}.{t}")
             tmp = fn + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(value)
@@ -87,3 +99,50 @@ class PlainStorage:
                     except ValueError:
                         continue
         return sorted(out)
+
+    def _inventory(self) -> dict[bytes, list[int]]:
+        """variable → timestamps, decoded from the directory listing;
+        caller holds the lock."""
+        try:
+            names = os.listdir(self.path)
+        except FileNotFoundError:
+            return {}
+        stems: dict[str, list[int]] = {}
+        for name in names:
+            stem, sep, suffix = name.rpartition(".")
+            if not sep:
+                continue
+            try:
+                t = int(suffix)
+            except ValueError:
+                continue  # .tmp / .k sidecars
+            stems.setdefault(stem, []).append(t)
+        out: dict[bytes, list[int]] = {}
+        for stem, ts in stems.items():
+            if stem.startswith("h"):
+                try:
+                    with open(os.path.join(self.path, stem + ".k"), "rb") as f:
+                        var = f.read()
+                except OSError:
+                    continue  # pre-sidecar legacy file: not enumerable
+            else:
+                try:
+                    var = bytes.fromhex(stem)
+                except ValueError:
+                    continue
+            out[var] = sorted(ts)
+        return out
+
+    def keys(self) -> list[bytes]:
+        """Every stored variable (storage contract — anti-entropy)."""
+        with self._lock:
+            return list(self._inventory())
+
+    def scan(self) -> list[tuple[bytes, int]]:
+        """Every stored ``(variable, t)`` pair, one directory walk."""
+        with self._lock:
+            return [
+                (var, t)
+                for var, ts in self._inventory().items()
+                for t in ts
+            ]

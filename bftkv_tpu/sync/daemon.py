@@ -1,0 +1,278 @@
+"""Anti-entropy daemon: background replica convergence without trust.
+
+A restarted, rejoined, or lagging replica converges by pulling from its
+peers instead of waiting for a client to read the exact keys it missed
+(the reference's only repair plane, protocol/client.go:281-302 — which
+silently erodes the ``3f+1`` margin for any key nobody re-reads).
+
+Each (jitter-scheduled) round:
+
+1. ``SYNC_DIGEST`` to the peer set — a digest is ≤ 8 KB, so polling is
+   the cheap half — and compare each peer's bucket hashes against the
+   local :class:`~bftkv_tpu.sync.digest.DigestTree`;
+2. ``SYNC_PULL`` the divergent buckets from up to ``f+1`` *distinct*
+   divergent peers: with at most ``f`` Byzantine replicas, at least one
+   pulled peer is honest, which is all liveness needs — safety needs
+   none;
+3. feed every pulled record through :func:`admit_records` — the FULL
+   local admission path.
+
+Admission re-runs exactly what the write handler runs: collective-
+signature sufficiency against the local AUTH quorum and keyring (all
+pulled signatures verify as ONE device batch through the installed
+``ops.dispatch`` verify dispatcher via ``collective.verify_many``),
+then timestamp monotonicity / equivocation / TOFU via the server's
+``_write_storage_checks``.  A Byzantine peer can therefore waste
+bandwidth but can never poison state: forged, replayed, cert-stripped,
+or re-keyed records all die in admission with ``sync.rejected``
+incremented and local state untouched.
+
+Metrics: ``sync.rounds``, ``sync.pull.records`` (admitted),
+``sync.rejected``, ``sync.pull.stale`` (honest-but-old), and
+``sync.pull.verify_batch`` (device batch size per pull).
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import threading
+
+from bftkv_tpu import packet as pkt
+from bftkv_tpu import quorum as qm
+from bftkv_tpu import transport as tp
+from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu.sync.digest import HIDDEN_PREFIX, latest_completed
+
+__all__ = ["SyncDaemon", "admit_records"]
+
+log = logging.getLogger("bftkv_tpu.sync")
+
+#: Upper bounds on one pull response: record count AND total bytes.
+#: The transport has already buffered the body by the time these apply
+#: (that bound is transport-wide), so what they cap is the parse +
+#: admission amplification a hostile peer can force per round.
+MAX_PULL_RECORDS = 8192
+#: Strictly above the worst case a conforming server can send (its
+#: 32 MiB budget may be overshot by one ≤32 MiB record plus list
+#: framing) — only a NON-conforming peer trips this, where discarding
+#: is correct and cannot livelock convergence.
+MAX_PULL_BYTES = 80 << 20
+
+
+def admit_records(server, records: list[bytes]) -> dict:
+    """Run pulled records through the full local admission path.
+
+    Returns counters: ``admitted`` / ``rejected`` / ``stale``.  Never
+    raises on record content — a hostile record is a counter bump, not
+    a daemon crash.
+    """
+    stats = {"admitted": 0, "rejected": 0, "stale": 0}
+    parsed: list[tuple[bytes, object, bytes] | None] = []
+    jobs: list[tuple[bytes, object]] = []
+    for raw in records[:MAX_PULL_RECORDS]:
+        try:
+            p = pkt.parse(raw)
+            variable = p.variable or b""
+            if variable.startswith(HIDDEN_PREFIX):
+                raise ValueError("hidden variable")
+            if p.sig is None or p.ss is None or not p.ss.completed:
+                raise ValueError("not a completed record")
+            if p.auth is not None:
+                # TPA-protected state never rides the sync plane
+                # (sync/digest.py latest_completed explains why).
+                raise ValueError("protected record")
+            local = latest_completed(server.storage, variable)
+            if local is not None:
+                lt, _lraw, lp = local
+                if lt > p.t:
+                    stats["stale"] += 1  # honest-but-old: not Byzantine
+                    parsed.append(None)
+                    continue
+                if lt == p.t and lp.value == p.value:
+                    parsed.append(None)  # already converged on this key
+                    continue
+            tbss = pkt.tbss(raw)
+        except Exception:
+            stats["rejected"] += 1
+            parsed.append(None)
+            continue
+        parsed.append((raw, p, tbss))
+        jobs.append((tbss, p.ss))
+
+    # ONE device batch for every pulled collective signature: verify_many
+    # routes through the installed ops.dispatch verify dispatcher, so a
+    # whole pull costs one kernel launch, not per-record host checks.
+    if jobs:
+        metrics.observe("sync.pull.verify_batch", len(jobs))
+        verrs = server.crypt.collective.verify_many(
+            jobs, server.qs.choose_quorum(qm.AUTH), server.crypt.keyring
+        )
+    else:
+        verrs = []
+
+    vi = 0
+    for entry in parsed:
+        if entry is None:
+            continue
+        raw, p, _tbss = entry
+        err = verrs[vi]
+        vi += 1
+        if err is not None:
+            stats["rejected"] += 1
+            continue
+        variable = p.variable or b""
+        try:
+            # Timestamp monotonicity, equivocation, and TOFU against the
+            # locally stored record — the same checks ``_write`` runs.
+            out = server._write_storage_checks(
+                variable, p.value, p.t, p.sig, p.ss, raw
+            )
+        except Exception:
+            stats["rejected"] += 1
+            continue
+        server._persist(variable, p.t, out)
+        stats["admitted"] += 1
+
+    metrics.incr("sync.pull.records", stats["admitted"])
+    metrics.incr("sync.rejected", stats["rejected"])
+    metrics.incr("sync.pull.stale", stats["stale"])
+    return stats
+
+
+class SyncDaemon:
+    """Background anti-entropy driver for one server."""
+
+    def __init__(
+        self,
+        server,
+        interval: float = 30.0,
+        jitter: float = 0.5,
+        rng: random.Random | None = None,
+    ):
+        self.server = server
+        self.interval = interval
+        self.jitter = jitter
+        self._rng = rng or random.Random()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "SyncDaemon":
+        if self._thread is None:
+            self._stop = threading.Event()  # a prior stop() left it set
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="bftkv-sync"
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def _loop(self) -> None:
+        # Local ref: a wedged thread abandoned by a timed-out stop()
+        # must keep honoring the OLD event, never a successor start()'s
+        # (the dispatch workers' discipline, ops/dispatch.py).
+        stop = self._stop
+        while not stop.is_set():
+            # Jittered so a fleet restarted together does not stampede.
+            delay = self.interval * (
+                1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+            )
+            if stop.wait(max(0.1, delay)):
+                return
+            try:
+                self.run_round()
+            except Exception:
+                log.exception("anti-entropy round failed")
+
+    # -- one round ---------------------------------------------------------
+
+    def _peers(self) -> list:
+        return [
+            n
+            for n in self.server.self_node.get_peers()
+            if getattr(n, "address", "") and getattr(n, "active", True)
+        ]
+
+    def _ask(self, cmd: int, peer, payload: bytes) -> bytes | None:
+        """Point-to-point request over the encrypted transport;
+        ``tp.multicast`` blocks until the single callback ran."""
+        box: dict = {}
+
+        def cb(res: tp.MulticastResponse) -> bool:
+            box["res"] = res
+            return True
+
+        self.server.tr.multicast(cmd, [peer], payload, cb)
+        res = box.get("res")
+        if res is None or res.err is not None:
+            return None
+        return res.data
+
+    def run_round(self) -> dict:
+        """One anti-entropy round: digest-poll the peer set (cheap — a
+        digest is ≤ 8 KB), then pull divergent buckets from up to
+        ``f+1`` distinct divergent peers.  With at most ``f`` Byzantine
+        replicas among them, at least one pulled peer is honest, so a
+        round reaches every record some honest divergent peer serves;
+        safety never depends on the count — admission re-verifies
+        everything.  Returns aggregate counters."""
+        stats = {"peers": 0, "pulled_peers": 0, "admitted": 0,
+                 "rejected": 0, "stale": 0}
+        peers = self._peers()
+        if not peers:
+            return stats
+        # get_peers() excludes self, so the replica count is
+        # len(peers)+1 and the fault bound is f = (n-1)//3 = peers//3 —
+        # computing it off the peer list directly would undercount by
+        # one for every n = 3f+1 cluster and let a single Byzantine
+        # peer absorb the whole round's pull budget.
+        f = len(peers) // 3
+        local = self.server._sync_tree()
+        divergent_peers: list[tuple[object, list[int]]] = []
+        for peer in peers:
+            stats["peers"] += 1
+            data = self._ask(tp.SYNC_DIGEST, peer, b"")
+            if data is None:
+                continue
+            try:
+                theirs = pkt.parse_digest(data)
+            except Exception:
+                metrics.incr("sync.rejected")
+                stats["rejected"] += 1
+                continue
+            mine = local.buckets()
+            divergent = [
+                b for b, h in sorted(theirs.items()) if mine.get(b) != h
+            ]
+            if divergent:
+                divergent_peers.append((peer, divergent))
+        self._rng.shuffle(divergent_peers)
+        for peer, divergent in divergent_peers[: f + 1]:
+            stats["pulled_peers"] += 1
+            raw = self._ask(
+                tp.SYNC_PULL, peer, pkt.serialize_bucket_ids(divergent)
+            )
+            if raw is None:
+                continue
+            if len(raw) > MAX_PULL_BYTES:
+                metrics.incr("sync.rejected")
+                stats["rejected"] += 1
+                continue
+            try:
+                records = pkt.parse_list(raw)
+            except Exception:
+                metrics.incr("sync.rejected")
+                stats["rejected"] += 1
+                continue
+            got = admit_records(self.server, records)
+            for k in ("admitted", "rejected", "stale"):
+                stats[k] += got[k]
+        metrics.incr("sync.rounds")
+        return stats

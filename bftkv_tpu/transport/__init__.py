@@ -48,6 +48,8 @@ __all__ = [
     "BATCH_SIGN",
     "BATCH_WRITE",
     "BATCH_READ",
+    "SYNC_DIGEST",
+    "SYNC_PULL",
     "PREFIX",
     "COMMAND_NAMES",
     "MulticastResponse",
@@ -78,6 +80,13 @@ BATCH_TIME = 13
 BATCH_SIGN = 14
 BATCH_WRITE = 15
 BATCH_READ = 16
+# Anti-entropy plane (no reference analog — the reference repairs stale
+# replicas only via client read-repair, client.go:281-302): peers
+# exchange keyspace digests and stream only divergent records; pulled
+# records pass the puller's FULL admission path, so these commands give
+# a Byzantine peer no authority (bftkv_tpu/sync).
+SYNC_DIGEST = 17
+SYNC_PULL = 18
 
 PREFIX = "/bftkv/v1/"
 
@@ -99,6 +108,8 @@ COMMAND_NAMES = {
     BATCH_SIGN: "batch_sign",
     BATCH_WRITE: "batch_write",
     BATCH_READ: "batch_read",
+    SYNC_DIGEST: "sync_digest",
+    SYNC_PULL: "sync_pull",
 }
 COMMANDS_BY_NAME = {v: k for k, v in COMMAND_NAMES.items()}
 

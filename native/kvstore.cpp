@@ -128,6 +128,30 @@ int64_t kv_versions(Store* s, const uint8_t* var, uint32_t varlen,
   return (int64_t)versions.size();
 }
 
+// Writes length-prefixed (u32 LE) variable names into out (cap bytes
+// of room); returns the total byte length needed for ALL names, or -1
+// on error. Call with out == nullptr / cap == 0 to size, then again
+// with a large-enough buffer (same two-call shape as kv_versions).
+// Keyspace enumeration backs the anti-entropy digest tree
+// (bftkv_tpu/sync); the reference's leveldb backend would use a
+// whole-range iterator the same way.
+int64_t kv_keys(Store* s, uint8_t* out, uint64_t cap) {
+  if (!s) return -1;
+  std::lock_guard<std::mutex> lock(s->mu);
+  uint64_t need = 0, off = 0;
+  for (const auto& kv : s->index) {
+    uint64_t rec = 4 + kv.first.size();
+    if (out && off + rec <= cap) {
+      uint32_t len = (uint32_t)kv.first.size();
+      memcpy(out + off, &len, 4);
+      memcpy(out + off + 4, kv.first.data(), kv.first.size());
+      off += rec;
+    }
+    need += rec;
+  }
+  return (int64_t)need;
+}
+
 // t == 0 means latest. Returns value length, or -1 if not found, or -2 on
 // I/O error. If out is non-null it must have room for the value (call once
 // with out == nullptr to size, then again to fetch; *t_out gets the

@@ -41,6 +41,7 @@ def homes(cluster, tmp_path_factory):
     return paths
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_register_enrolls_a_virgin_user(cluster, homes):
     """A fresh identity with zero counter-signatures registers, gains a
     quorum certificate, and can then write (reference: api_test.go:48-140)."""

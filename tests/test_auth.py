@@ -40,6 +40,7 @@ def make_servers(password: bytes, n: int, k: int, proofs=None):
     }
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_full_roundtrip_n10_k7():
     password = b"correct horse battery staple"
     n, k = 10, 7

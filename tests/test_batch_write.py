@@ -105,6 +105,7 @@ def test_write_many_two_clients_see_each_other(cluster):
     assert a.read(b"batch/shared-0") == b"from-b"
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_write_many_with_dispatchers_installed(cluster):
     """The pipeline's device batches coalesce through the global
     dispatchers exactly like the single path."""
@@ -227,6 +228,7 @@ def test_concurrent_overlapping_batches_converge(cluster):
         assert b.read(v) == got
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_batch_pipeline_at_64_replicas():
     """BASELINE-scale smoke: the batch pipeline through a 64-replica +
     8-storage-node universe (1024-bit keys keep the host-crypto CPU

@@ -101,6 +101,7 @@ def test_collusion_over_http_with_dispatchers(fullstack_mal_cluster):
     assert mal_server_ids <= set(honest.self_node.revoked)
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_batch_pipeline_safe_over_http_with_dispatchers(
     fullstack_mal_cluster,
 ):
@@ -118,6 +119,7 @@ def test_batch_pipeline_safe_over_http_with_dispatchers(
     ]
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_batched_read_fallback_at_64_replicas():
     """The signed-candidate read fallback (protocol/client.py
     _resolve_complete_fanout_many) at the 64-replica shape: after an

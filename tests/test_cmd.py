@@ -144,6 +144,7 @@ def test_daemon_show_and_metrics(cluster):
     assert isinstance(snap, dict)
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_daemon_profile_endpoint(cluster):
     """The jax-profiler trace endpoint (pprof analog,
     reference: cmd/bftkv/main.go:20,253) captures a trace directory

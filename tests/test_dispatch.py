@@ -127,6 +127,7 @@ def test_sign_dispatcher_mixed_rsa_ec_batch(keypair):
         d.stop()
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_ec_signers_coalesce_across_threads():
     """Concurrent EC writers' batches merge into shared flushes, the
     same coalescing the RSA path has always had (ADVICE r4 #3)."""

@@ -66,6 +66,7 @@ def test_ec_collusion_convergence_and_revocation(ec_mal_cluster):
     assert evil_ident.cert.id in honest.self_node.revoked
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_ec_batch_pipeline_survives_colluders(ec_mal_cluster):
     c, _ = ec_mal_cluster
     honest = c.clients[1]
@@ -74,6 +75,7 @@ def test_ec_batch_pipeline_survives_colluders(ec_mal_cluster):
     assert honest.read_many([v for v, _ in items]) == [v for _, v in items]
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_threshold_ca_on_ec_identity_cluster():
     """The decentralized CA over a pure-EC identity cluster: RSA and
     ECDSA CA keys distribute (shares ECIES-encrypted per recipient via

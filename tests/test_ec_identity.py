@@ -33,6 +33,7 @@ def test_ecdsa_sign_verify_roundtrip():
     assert ecdsa.sign(b"hello", key) == sig
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_ecdsa_batch_sign_and_verify(monkeypatch):
     # Force the device path (crossover would keep these tiny batches on
     # host and skip the kernels under test).
@@ -146,7 +147,14 @@ def test_verifier_domain_mixed_batch():
     assert got.tolist() == [True, True, True, False]
 
 
-@pytest.mark.parametrize("alg", ["p256", "mixed"])
+@pytest.mark.parametrize(
+    "alg",
+    [
+        # The all-EC variant pays the cold scalar-mult jits; tier-2.
+        pytest.param("p256", marks=pytest.mark.slow),
+        "mixed",
+    ],
+)
 def test_cluster_on_ec_keys(alg):
     from tests.cluster_utils import start_cluster
 

@@ -50,6 +50,7 @@ def test_edge_cases():
     assert got[4] == (p1[0], (-p1[1]) % P256.p)
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_add_batch_including_cancellation():
     d = ec_ops.p256()
     a = P256.scalar_base_mult(111)

@@ -17,6 +17,7 @@ from bftkv_tpu.crypto.ec import P256  # noqa: E402
 from bftkv_tpu.ops import ec_rns  # noqa: E402
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_scalar_mult_matches_host_oracle():
     pts, ks, want = [], [], []
     for i in range(8):

@@ -29,6 +29,7 @@ def test_mesh_exists():
     assert not rns._shardable(7)  # indivisible batches stay single-dev
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_sharded_verify_matches_single_device():
     key1, key2 = rsa.generate(2048), rsa.generate(2048)
     ctx = rns.context()
@@ -66,6 +67,7 @@ def test_sharded_verify_matches_single_device():
     assert public.tolist() == want
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_sharded_pow_matches_single_device_and_host():
     ctx = rns.context(32, 512)
     mods, bases, exps = [], [], []

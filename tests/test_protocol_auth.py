@@ -100,6 +100,7 @@ def test_threshold_dsa_ca(cluster):
 
 
 def test_threshold_ecdsa_ca(cluster):
+    pytest.importorskip("cryptography")  # oracle cross-check needs the host lib
     from cryptography.hazmat.primitives import hashes
     from cryptography.hazmat.primitives.asymmetric import ec as cec
     from cryptography.hazmat.primitives.asymmetric.utils import (
@@ -123,6 +124,7 @@ def test_threshold_ecdsa_ca(cluster):
     pubkey.verify(encode_dss_signature(r, s), tbs, cec.ECDSA(hashes.SHA256()))
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_threshold_repeated_rounds_5_of_9():
     """Repeated dist_sign rounds at (t,n)=(5,9): regression for the
     session-reordering race — a second signing round's server-to-server
@@ -160,6 +162,7 @@ def test_threshold_x509_issuance(cluster):
     (reference: cmd/bftrw/bftrw.go:216-302)."""
     import datetime
 
+    pytest.importorskip("cryptography")  # X.509 interop needs the host lib
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import (

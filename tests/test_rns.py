@@ -35,6 +35,7 @@ def _verify_rns_direct(items):
     )
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_rns_matches_oracle_mixed_keys(keys):
     items = []
     want = []
@@ -55,6 +56,7 @@ def test_rns_matches_oracle_mixed_keys(keys):
     assert want == [True, True, False, True, False, True]
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_rns_wrong_key_rejected(keys):
     m = b"cross"
     sig = rsa.sign(m, keys[0])
@@ -62,6 +64,7 @@ def test_rns_wrong_key_rejected(keys):
     assert not got.any()
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_verifier_domain_backends_agree(keys):
     """All three device backends (rns / limb / pallas) return identical
     verdicts on the same adversarial batch."""

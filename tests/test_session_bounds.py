@@ -9,6 +9,8 @@ justified keeping sessions alive in the first place).
 
 from __future__ import annotations
 
+import pytest
+
 from bftkv_tpu.crypto import cert as certmod
 from bftkv_tpu.crypto import rsa
 from bftkv_tpu.crypto.message import MessageSecurity
@@ -20,6 +22,7 @@ def _cluster():
     return start_cluster(4, 1, 4)
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_auth_session_map_bounded():
     c = _cluster()
     try:

@@ -186,6 +186,7 @@ def test_hmac_roundtrip_and_fail_closed():
         srv.shutdown()
 
 
+@pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)
 def test_port_squatter_verdicts_rejected():
     # An impostor on the sidecar port returns all-true without knowing
     # the secret; a keyed client must fail closed (local verify), not

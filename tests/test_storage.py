@@ -108,6 +108,69 @@ def test_persistence_across_reopen(cls, tmp_path):
         s.close()
 
 
+def test_keys_and_scan(store):
+    # keys()/scan() are part of the storage contract (the anti-entropy
+    # digest tree enumerates the keyspace with them; bftkv_tpu/sync).
+    assert store.keys() == []
+    assert store.scan() == []
+    long_var = b"\xff" * 200  # hash-stemmed in the plain backend
+    store.write(b"x", 1, b"a")
+    store.write(b"x", 3, b"c")
+    store.write(b"y", 2, b"b")
+    store.write(long_var, 7, b"z")
+    assert sorted(store.keys()) == sorted([b"x", b"y", long_var])
+    assert sorted(store.scan()) == sorted(
+        [(b"x", 1), (b"x", 3), (b"y", 2), (long_var, 7)]
+    )
+    # Overwriting an existing version must not duplicate inventory rows.
+    store.write(b"x", 3, b"c2")
+    assert sorted(store.keys()) == sorted([b"x", b"y", long_var])
+    assert len(store.scan()) == 4
+
+
+def test_backend_differential_parity(tmp_path):
+    """Drive the identical write/read/versions/keys/scan sequence
+    through all three backends and assert identical observable results
+    — the contract is one, the engines are three."""
+    import random
+
+    backends = {
+        "mem": MemStorage(),
+        "plain": PlainStorage(str(tmp_path / "p")),
+        "native": NativeStorage(str(tmp_path / "n.log")),
+    }
+    rng = random.Random(42)
+    variables = [b"a", b"b" * 40, b"\x00\x01", b"h" * 120, b""]
+    ops = []
+    for _ in range(120):
+        var = rng.choice(variables)
+        t = rng.randint(1, 12)
+        ops.append((var, t, b"v%d-%d" % (t, rng.randint(0, 3))))
+
+    for var, t, val in ops:
+        for s in backends.values():
+            s.write(var, t, val)
+
+    def observe(s):
+        out = {
+            "keys": sorted(s.keys()),
+            "scan": sorted(s.scan()),
+        }
+        for var in variables:
+            out[("versions", var)] = sorted(s.versions(var))
+            for t in [0] + sorted({t for v, t, _ in ops if v == var}):
+                try:
+                    out[("read", var, t)] = s.read(var, t)
+                except ERR_NOT_FOUND:
+                    out[("read", var, t)] = None
+        return out
+
+    views = {name: observe(s) for name, s in backends.items()}
+    assert views["mem"] == views["plain"]
+    assert views["mem"] == views["native"]
+    backends["native"].close()
+
+
 def test_native_large_values(tmp_path):
     s = NativeStorage(str(tmp_path / "db.log"))
     big = bytes(1024 * 1024)

@@ -234,6 +234,7 @@ def test_ecdsa_threshold_roundtrip():
     r = int.from_bytes(sig[:size], "big")
     s = int.from_bytes(sig[size:], "big")
     # cross-check against the host crypto library
+    pytest.importorskip("cryptography")
     from cryptography.hazmat.primitives import hashes
     from cryptography.hazmat.primitives.asymmetric import ec as cec
     from cryptography.hazmat.primitives.asymmetric.utils import (
