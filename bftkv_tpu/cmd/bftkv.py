@@ -34,6 +34,7 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from bftkv_tpu.errors import ERR_NOT_FOUND, Error
+from bftkv_tpu.metrics import registry as metrics
 
 MAX_UINT64 = (1 << 64) - 1
 
@@ -110,9 +111,22 @@ class _ApiHandler(BaseHTTPRequestHandler):
 
     _MUTATING = ("/write/", "/writeonce/", "/joining", "/leaving")
 
+    #: Fixed endpoint names for the api.requests label — anything else
+    #: (including variable-bearing paths' tails) collapses to "other"
+    #: so hostile URLs cannot blow up label cardinality.
+    _ENDPOINTS = frozenset(
+        ("read", "write", "writeonce", "joining", "leaving", "show",
+         "visual", "debug", "metrics", "trace")
+    )
+
     def _handle(self):
         svc = self.server.svc
         path = self.path
+        ep = path.split("?", 1)[0].split("/", 2)[1] if "/" in path else ""
+        metrics.incr(
+            "api.requests",
+            labels={"endpoint": ep if ep in self._ENDPOINTS else "other"},
+        )
         # Always drain the body: HTTP/1.1 keep-alive reuses the
         # connection, and unread bytes would be parsed as the next
         # request line.
@@ -199,10 +213,45 @@ class _ApiHandler(BaseHTTPRequestHandler):
                     f"trace captured to {outdir}\n".encode(),
                     "text/plain",
                 )
-            elif path == "/metrics":
-                from bftkv_tpu.metrics import registry as metrics
+            elif path == "/metrics" or path.startswith("/metrics?"):
+                # Content negotiation: Prometheus scrapers ask for text
+                # (or pass ?format=prometheus); everyone else keeps the
+                # original JSON snapshot.
+                q = urllib.parse.parse_qs(urllib.parse.urlparse(path).query)
+                accept = self.headers.get("accept") or ""
+                want_prom = q.get("format", [""])[0] == "prometheus" or (
+                    "application/json" not in accept
+                    and ("text/plain" in accept or "openmetrics" in accept)
+                )
+                if want_prom:
+                    self._reply(
+                        200,
+                        metrics.prometheus().encode(),
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                else:
+                    body = json.dumps(
+                        metrics.snapshot(), sort_keys=True
+                    ).encode()
+                    self._reply(200, body, "application/json")
+            elif path == "/trace" or path.startswith("/trace?"):
+                from bftkv_tpu import trace as trmod
 
-                body = json.dumps(metrics.snapshot(), sort_keys=True).encode()
+                q = urllib.parse.parse_qs(urllib.parse.urlparse(path).query)
+                try:
+                    limit = int(q.get("limit", ["20"])[0])
+                except ValueError:
+                    limit = 20
+                limit = max(1, min(limit, 200))
+                body = json.dumps(
+                    {
+                        "slow_threshold_s": trmod.tracer.slow_threshold,
+                        "slow": trmod.tracer.slow(),
+                        "recent": trmod.tracer.traces(limit),
+                    },
+                    sort_keys=True,
+                    default=str,
+                ).encode()
                 self._reply(200, body, "application/json")
             else:
                 self._reply(404, b"unknown endpoint\n", "text/plain")
@@ -273,6 +322,12 @@ def main(argv: list[str] | None = None) -> int:
                          "local admission path — a restarted or "
                          "lagging replica converges without client "
                          "traffic (bftkv_tpu/sync)")
+    ap.add_argument("--slow-trace", type=float, default=None,
+                    metavar="SECONDS",
+                    help="slow-request threshold: a request trace whose "
+                         "root span exceeds it is kept on /trace and "
+                         "logged as one JSON line (default from "
+                         "BFTKV_SLOW_TRACE_SECONDS, else 1.0)")
     ap.add_argument("--dispatch", action="store_true",
                     help="install the TPU verify/sign dispatchers "
                          "(one replica process per accelerator)")
@@ -303,6 +358,10 @@ def main(argv: list[str] | None = None) -> int:
         args.db = args.home.rstrip("/") + ".db"
     if not args.revlist:
         args.revlist = args.home.rstrip("/") + ".rev"
+    if args.slow_trace is not None:
+        from bftkv_tpu import trace as trmod
+
+        trmod.tracer.slow_threshold = args.slow_trace
 
     server, graph, crypt, qs, tr = build_server(args)
 

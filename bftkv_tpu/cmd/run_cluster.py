@@ -45,6 +45,7 @@ def spawn(
     client_home: str = "",
     verify_sidecar: str = "",
     anti_entropy: float = 0.0,
+    slow_trace: float | None = None,
     extra_env: dict | None = None,
 ) -> list[subprocess.Popen]:
     """``verify_sidecar``: "auto" spawns one shared sidecar process and
@@ -94,6 +95,8 @@ def spawn(
             cmd += ["--verify-sidecar", verify_sidecar]
         if anti_entropy > 0:
             cmd += ["--anti-entropy", str(anti_entropy)]
+        if slow_trace is not None:
+            cmd += ["--slow-trace", str(slow_trace)]
         procs.append(subprocess.Popen(cmd, env=env))
     return procs
 
@@ -132,6 +135,10 @@ def main(argv: list[str] | None = None) -> int:
                     metavar="SECONDS",
                     help="per-daemon background state-sync interval "
                          "(jittered; 0 disables — see bftkv --help)")
+    ap.add_argument("--slow-trace", type=float, default=None,
+                    metavar="SECONDS",
+                    help="per-daemon slow-request trace threshold "
+                         "(see bftkv --help)")
     args = ap.parse_args(argv)
 
     homes = server_homes(args.keys)
@@ -142,7 +149,8 @@ def main(argv: list[str] | None = None) -> int:
                   api_base=args.api_base, api_host=args.api_host,
                   bind_host=args.bind_host, client_home=args.client_home,
                   verify_sidecar=args.verify_sidecar,
-                  anti_entropy=args.anti_entropy)
+                  anti_entropy=args.anti_entropy,
+                  slow_trace=args.slow_trace)
     # The sidecar (if spawned, always first) is an optional optimizer
     # whose clients fall back to local verification: its death must not
     # tear down the replica fleet, and it is not a "server".

@@ -5,50 +5,116 @@ TPU framework needs them to steer batching — sig-verifies/sec, device
 batch occupancy, quorum latencies are the signals the dispatcher and
 the benchmark harness read.  Deliberately dependency-free and cheap:
 one lock, plain dicts, snapshot on demand.
+
+Every instrument takes optional ``labels`` (a small dict of low-
+cardinality dimensions — command names, transport kind, never
+variables or peer addresses; cardinality rules in docs/DESIGN.md §7).
+Two export surfaces:
+
+- :meth:`Metrics.snapshot` — the historical flat JSON dict; labeled
+  series flatten to ``name{k=v,...}`` keys, unlabeled keys are
+  unchanged so existing consumers keep working;
+- :meth:`Metrics.prometheus` — Prometheus text exposition (0.0.4):
+  counters as ``bftkv_<name>_total``, gauges as ``bftkv_<name>``,
+  ``observe()`` series as summaries (``_count``/``_sum`` + quantiles).
 """
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 from collections import defaultdict
 
 __all__ = ["Metrics", "registry"]
 
+#: Label sets are stored as sorted (key, value) tuples; () = unlabeled.
+_NO_LABELS: tuple = ()
+
+
+def _key(name: str, labels: dict | None) -> tuple[str, tuple]:
+    if not labels:
+        return (name, _NO_LABELS)
+    return (name, tuple(sorted(labels.items())))
+
+
+def _flat(name: str, labels: tuple) -> str:
+    """Flat JSON-snapshot key: ``name`` or ``name{k=v,...}``."""
+    if not labels:
+        return name
+    return name + "{" + ",".join(f"{k}={v}" for k, v in labels) + "}"
+
+
+def _prom_name(name: str) -> str:
+    return "bftkv_" + re.sub(r"[^a-zA-Z0-9_:]", "_", name)
+
+
+def _prom_labels(labels: tuple, extra: tuple = ()) -> str:
+    items = tuple(labels) + tuple(extra)
+    if not items:
+        return ""
+
+    def esc(v) -> str:
+        return (
+            str(v)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+
+    return "{" + ",".join(f'{k}="{esc(v)}"' for k, v in items) + "}"
+
+
+def _prom_value(v) -> str:
+    return repr(v) if isinstance(v, float) else str(v)
+
 
 class Metrics:
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters: dict[str, int] = defaultdict(int)
-        self._sums: dict[str, float] = defaultdict(float)
-        self._samples: dict[str, list[float]] = defaultdict(list)
+        self._counters: dict[tuple, int] = defaultdict(int)
+        self._gauges: dict[tuple, float] = {}
+        self._counts: dict[tuple, int] = defaultdict(int)
+        self._sums: dict[tuple, float] = defaultdict(float)
+        self._samples: dict[tuple, list[float]] = defaultdict(list)
         # Ring-buffer write cursors: the histogram must keep admitting
         # values forever.  The old append-until-full behavior froze each
         # series at its first 65536 samples, so a daemon's p50/p99
         # reported startup behavior for the rest of its life.
-        self._sample_pos: dict[str, int] = defaultdict(int)
+        self._sample_pos: dict[tuple, int] = defaultdict(int)
         self._max_samples = 65536
 
-    def incr(self, name: str, n: int = 1) -> None:
+    def incr(self, name: str, n: int = 1, labels: dict | None = None) -> None:
         with self._lock:
-            self._counters[name] += n
+            self._counters[_key(name, labels)] += n
 
-    def observe(self, name: str, value: float) -> None:
+    def gauge(
+        self, name: str, value: float, labels: dict | None = None
+    ) -> None:
+        """Last-write-wins instantaneous value (queue depth, occupancy,
+        throughput of the latest flush)."""
+        with self._lock:
+            self._gauges[_key(name, labels)] = value
+
+    def observe(
+        self, name: str, value: float, labels: dict | None = None
+    ) -> None:
         """Record one sample (latency seconds, batch size, ...).
 
         Samples land in a per-series ring buffer: totals (`.count` /
         `.sum`) cover the whole run while percentiles reflect the most
         recent ``_max_samples`` window."""
+        k = _key(name, labels)
         with self._lock:
-            self._counters[name + ".count"] += 1
-            self._sums[name + ".sum"] += value
-            s = self._samples[name]
+            self._counts[k] += 1
+            self._sums[k] += value
+            s = self._samples[k]
             if len(s) < self._max_samples:
                 s.append(value)
             else:
-                s[self._sample_pos[name]] = value
-                self._sample_pos[name] = (
-                    self._sample_pos[name] + 1
+                s[self._sample_pos[k]] = value
+                self._sample_pos[k] = (
+                    self._sample_pos[k] + 1
                 ) % self._max_samples
 
     class _Timer:
@@ -66,29 +132,108 @@ class Metrics:
     def timer(self, name: str) -> "Metrics._Timer":
         return Metrics._Timer(self, name)
 
-    def percentile(self, name: str, q: float) -> float | None:
+    def percentile(
+        self, name: str, q: float, labels: dict | None = None
+    ) -> float | None:
+        # Copy under the lock, sort outside: sorting up to 65536
+        # samples while holding the lock stalled every concurrent
+        # observe() for the duration of the sort.
         with self._lock:
-            s = sorted(self._samples.get(name, ()))
+            s = list(self._samples.get(_key(name, labels), ()))
         if not s:
             return None
+        s.sort()
         i = min(len(s) - 1, int(q * len(s)))
         return s[i]
 
     def snapshot(self) -> dict:
         with self._lock:
-            out: dict = dict(self._counters)
-            out.update(self._sums)
-            # Copy the series under the lock: concurrent observe() of a
-            # *new* name would otherwise mutate the dict mid-iteration.
-            series = {n: sorted(s) for n, s in self._samples.items() if s}
-        for name, s in series.items():
+            # Copy everything under the lock — concurrent incr/observe
+            # of a *new* name would otherwise mutate dicts
+            # mid-iteration — but sort OUTSIDE it (see percentile()).
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            counts = dict(self._counts)
+            sums = dict(self._sums)
+            series = {k: list(s) for k, s in self._samples.items() if s}
+        out: dict = {}
+        for (name, labels), v in counters.items():
+            out[_flat(name, labels)] = v
+        for (name, labels), v in gauges.items():
+            out[_flat(name, labels)] = v
+        for (name, labels), v in counts.items():
+            out[_flat(name + ".count", labels)] = v
+        for (name, labels), v in sums.items():
+            out[_flat(name + ".sum", labels)] = v
+        for (name, labels), s in series.items():
+            s.sort()
             for q, tag in ((0.5, "p50"), (0.99, "p99")):
-                out[f"{name}.{tag}"] = s[min(len(s) - 1, int(q * len(s)))]
+                out[_flat(f"{name}.{tag}", labels)] = s[
+                    min(len(s) - 1, int(q * len(s)))
+                ]
         return out
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition, format 0.0.4.
+
+        Counter names end in ``_total``; ``observe()`` series render as
+        summaries (``{quantile="..."}`` samples over the recent window,
+        ``_sum``/``_count`` over the whole run); gauges are plain."""
+        with self._lock:
+            counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            counts = dict(self._counts)
+            sums = dict(self._sums)
+            series = {k: list(s) for k, s in self._samples.items() if s}
+
+        lines: list[str] = []
+
+        def by_name(d: dict) -> dict[str, list]:
+            g: dict[str, list] = {}
+            for (name, labels), v in d.items():
+                g.setdefault(name, []).append((labels, v))
+            return g
+
+        for name, rows in sorted(by_name(counters).items()):
+            pn = _prom_name(name) + "_total"
+            lines.append(f"# TYPE {pn} counter")
+            for labels, v in sorted(rows):
+                lines.append(f"{pn}{_prom_labels(labels)} {_prom_value(v)}")
+
+        for name, rows in sorted(by_name(gauges).items()):
+            pn = _prom_name(name)
+            lines.append(f"# TYPE {pn} gauge")
+            for labels, v in sorted(rows):
+                lines.append(f"{pn}{_prom_labels(labels)} {_prom_value(v)}")
+
+        for name, rows in sorted(by_name(series).items()):
+            pn = _prom_name(name)
+            lines.append(f"# TYPE {pn} summary")
+            for labels, s in sorted(rows):
+                s.sort()
+                for q in (0.5, 0.9, 0.99):
+                    v = s[min(len(s) - 1, int(q * len(s)))]
+                    lines.append(
+                        f"{pn}{_prom_labels(labels, (('quantile', q),))}"
+                        f" {_prom_value(v)}"
+                    )
+                key = (name, labels)
+                lines.append(
+                    f"{pn}_sum{_prom_labels(labels)}"
+                    f" {_prom_value(sums.get(key, 0.0))}"
+                )
+                lines.append(
+                    f"{pn}_count{_prom_labels(labels)}"
+                    f" {_prom_value(counts.get(key, 0))}"
+                )
+
+        return "\n".join(lines) + "\n"
 
     def reset(self) -> None:
         with self._lock:
             self._counters.clear()
+            self._gauges.clear()
+            self._counts.clear()
             self._sums.clear()
             self._samples.clear()
             self._sample_pos.clear()

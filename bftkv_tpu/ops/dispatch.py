@@ -41,6 +41,7 @@ import time
 
 import numpy as np
 
+from bftkv_tpu import trace
 from bftkv_tpu.metrics import registry as metrics
 
 __all__ = [
@@ -271,27 +272,51 @@ class _BatchDispatcher:
 
     def _flush(self, batch: list[_Pending]) -> None:
         flat = [it for p in batch for it in p.items]
+        occupancy = len(flat) / self.max_batch
         metrics.observe(f"{self.name}.batch", len(flat))
+        metrics.gauge(f"{self.name}.occupancy", occupancy)
         metrics.incr(f"{self.name}.flushes")
         metrics.incr(f"{self.name}.items", len(flat))
-        try:
-            if len(flat) <= self.max_batch:
-                out = self._run_batch(flat)
-            else:
-                # A burst can out-run the collector and drain as one
-                # oversized queue; chunk the device launches so padded
-                # batch shapes stay bounded by max_batch.
-                out = self._combine(
-                    [
-                        self._run_batch(flat[i : i + self.max_batch])
-                        for i in range(0, len(flat), self.max_batch)
-                    ]
-                )
-        except Exception as e:
-            for p in batch:
-                p.error = e
-                p.event.set()
-            return
+        t0 = time.perf_counter()
+        # Each flush is its own (root) trace: device batches are shared
+        # across requests, so they cannot belong to any one request's
+        # trace — the span carries the batch shape and, once the launch
+        # returns, the measured items/s the batch actually achieved.
+        with trace.span(
+            f"{self.name}.flush",
+            attrs={
+                "batch_size": len(flat),
+                "occupancy": round(occupancy, 4),
+            },
+        ) as sp:
+            try:
+                if len(flat) <= self.max_batch:
+                    out = self._run_batch(flat)
+                else:
+                    # A burst can out-run the collector and drain as one
+                    # oversized queue; chunk the device launches so padded
+                    # batch shapes stay bounded by max_batch.
+                    out = self._combine(
+                        [
+                            self._run_batch(flat[i : i + self.max_batch])
+                            for i in range(0, len(flat), self.max_batch)
+                        ]
+                    )
+            except Exception as e:
+                # Swallow, never raise: the error reaches every caller
+                # through its future, and raising here would kill the
+                # collector / flush-worker thread for good.
+                sp.attrs["error"] = repr(e)
+                for p in batch:
+                    p.error = e
+                    p.event.set()
+                return
+            dt = time.perf_counter() - t0
+            metrics.observe(f"{self.name}.flush.seconds", dt)
+            if dt > 0:
+                throughput = len(flat) / dt
+                sp.attrs["items_per_s"] = round(throughput, 1)
+                metrics.gauge(f"{self.name}.throughput", throughput)
         off = 0
         for p in batch:
             p.result = out[off : off + len(p.items)]

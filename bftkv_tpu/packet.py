@@ -400,6 +400,44 @@ def parse_bucket_ids(data: bytes) -> list[int]:
     return out
 
 
+# -- trace-context envelope (observability plane; no reference analog) -----
+# The transport fan-out prepends this to the PLAINTEXT payload before
+# session encryption, so a request's trace context crosses nodes (and
+# processes) without touching the HTTP surface or the session layer;
+# Server.handler strips it right after decrypt.  Unambiguous against
+# every legitimate payload: a packet starts with the 8-byte big-endian
+# length of ``variable``, bound-checked against the buffer, so its
+# first byte is 0x00 for any packet under 2^56 bytes — 0xff can never
+# begin a valid packet — and an auth request starts with a phase byte
+# that conforming clients keep tiny.
+
+TRACE_MAGIC = b"\xffTRC"
+_TRACE_HDR = len(TRACE_MAGIC) + 16
+
+
+def wrap_trace(trace_id: int, span_id: int, payload: bytes) -> bytes:
+    return (
+        TRACE_MAGIC
+        + trace_id.to_bytes(8, "big")
+        + span_id.to_bytes(8, "big")
+        + payload
+    )
+
+
+def unwrap_trace(data: bytes) -> tuple[tuple[int, int] | None, bytes]:
+    """``(context, payload)``: context is ``(trace_id, span_id)`` when
+    the envelope is present, else None with the data untouched."""
+    if len(data) >= _TRACE_HDR and data[: len(TRACE_MAGIC)] == TRACE_MAGIC:
+        return (
+            (
+                int.from_bytes(data[4:12], "big"),
+                int.from_bytes(data[12:20], "big"),
+            ),
+            data[_TRACE_HDR:],
+        )
+    return None, data
+
+
 def write_bigint(buf: io.BytesIO, n: int | None) -> None:
     """(reference: packet/packet.go:288-294)"""
     if n is None:

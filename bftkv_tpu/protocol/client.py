@@ -24,6 +24,7 @@ import threading
 
 from bftkv_tpu import packet as pkt
 from bftkv_tpu import quorum as qm
+from bftkv_tpu import trace
 from bftkv_tpu import transport as tp
 from bftkv_tpu.crypto import auth as authmod
 from bftkv_tpu.crypto import cert as certmod
@@ -177,8 +178,11 @@ class Client(Protocol):
     def write(self, variable: bytes, value: bytes, proof=None) -> None:
         """Three-phase signed write: collect timestamps from a READ|AUTH
         quorum, then sign + store (reference: client.go:62-92)."""
-        with metrics.timer("client.write.latency"):
-            qr = self.qs.choose_quorum(qm.READ | qm.AUTH)
+        with metrics.timer("client.write.latency"), trace.span(
+            "client.write", attrs={"value_bytes": len(value)}
+        ):
+            with trace.span("quorum.select"):
+                qr = self.qs.choose_quorum(qm.READ | qm.AUTH)
             maxt = 0
             actives: list = []
             failure: list = []
@@ -194,7 +198,8 @@ class Client(Protocol):
                 failure.append(res.peer)
                 return qr.reject(failure)
 
-            self.tr.multicast(tp.TIME, qr.nodes(), variable, cb)
+            with trace.span("phase.time", attrs={"peers": len(qr.nodes())}):
+                self.tr.multicast(tp.TIME, qr.nodes(), variable, cb)
             if not qr.is_threshold(actives):
                 raise ERR_INSUFFICIENT_NUMBER_OF_QUORUM
             if maxt == MAX_UINT64:
@@ -226,7 +231,8 @@ class Client(Protocol):
             errs.append(res.err)
             return qw.reject(failure)
 
-        self.tr.multicast(tp.WRITE, qw.nodes(), data, cb)
+        with trace.span("phase.write", attrs={"peers": len(qw.nodes())}):
+            self.tr.multicast(tp.WRITE, qw.nodes(), data, cb)
         if not qw.is_threshold(nodes):
             raise majority_error(errs, ERR_INSUFFICIENT_NUMBER_OF_RESPONSES)
 
@@ -236,42 +242,47 @@ class Client(Protocol):
         """Self-sign <x,v,t>, then accumulate quorum members' signature
         shares into a collective signature until sufficient
         (reference: client.go:125-170).  Returns ``(sig, ss)``."""
-        tbs = pkt.serialize(variable, value, t, nfields=3)
-        sig = self.crypt.signer.issue(tbs)
-        tbss = pkt.serialize(variable, value, t, sig, nfields=4)
+        with trace.span("phase.sign") as sp:
+            tbs = pkt.serialize(variable, value, t, nfields=3)
+            sig = self.crypt.signer.issue(tbs)
+            tbss = pkt.serialize(variable, value, t, sig, nfields=4)
 
-        qa = self.qs.choose_quorum(qm.AUTH | qm.PEER)
-        # The client's auth proof rides in the ss slot of the request
-        # (reference: client.go:142).
-        req = pkt.serialize(variable, value, t, sig, proof)
-        ss = None
-        failure: list = []
-        errs: list = []
+            qa = self.qs.choose_quorum(qm.AUTH | qm.PEER)
+            sp.attrs["peers"] = len(qa.nodes())
+            # The client's auth proof rides in the ss slot of the request
+            # (reference: client.go:142).
+            req = pkt.serialize(variable, value, t, sig, proof)
+            ss = None
+            failure: list = []
+            errs: list = []
 
-        def cb(res: tp.MulticastResponse) -> bool:
-            nonlocal ss
-            err = res.err
-            if err is None and res.data is not None:
+            def cb(res: tp.MulticastResponse) -> bool:
+                nonlocal ss
+                err = res.err
+                if err is None and res.data is not None:
+                    try:
+                        share = pkt.parse_signature(res.data)
+                        ss, done = self.crypt.collective.combine(
+                            ss, share, qa, self.crypt.keyring
+                        )
+                        return done
+                    except Exception as e:
+                        err = e
+                if err is None:
+                    return False
+                errs.append(err)
+                failure.append(res.peer)
+                return qa.reject(failure)
+
+            self.tr.multicast(tp.SIGN, qa.nodes(), req, cb)
+            with trace.span("verify.collective"):
                 try:
-                    share = pkt.parse_signature(res.data)
-                    ss, done = self.crypt.collective.combine(
-                        ss, share, qa, self.crypt.keyring
+                    self.crypt.collective.verify(
+                        tbss, ss, qa, self.crypt.keyring
                     )
-                    return done
                 except Exception as e:
-                    err = e
-            if err is None:
-                return False
-            errs.append(err)
-            failure.append(res.peer)
-            return qa.reject(failure)
-
-        self.tr.multicast(tp.SIGN, qa.nodes(), req, cb)
-        try:
-            self.crypt.collective.verify(tbss, ss, qa, self.crypt.keyring)
-        except Exception as e:
-            raise majority_error(errs, e)
-        return sig, ss
+                    raise majority_error(errs, e)
+            return sig, ss
 
     # -- batched write pipeline (no reference analog) ---------------------
 
@@ -301,9 +312,12 @@ class Client(Protocol):
         n = len(items)
         results: list[Exception | None] = [None] * n
 
-        with metrics.timer("client.write_many.latency"):
+        with metrics.timer("client.write_many.latency"), trace.span(
+            "client.write_many", attrs={"batch": n}
+        ):
             # ---- phase 1: timestamps (reference: client.go:62-92) ----
-            qr = self.qs.choose_quorum(qm.READ | qm.AUTH)
+            with trace.span("quorum.select"):
+                qr = self.qs.choose_quorum(qm.READ | qm.AUTH)
             maxts = [0] * n
             tally = _BatchTally(n, qr.is_threshold, qr.reject)
 
@@ -319,7 +333,9 @@ class Client(Protocol):
                     maxts[i] = t
                 return None
 
-            with metrics.timer("client.write_many.phase_time"):
+            with metrics.timer("client.write_many.phase_time"), trace.span(
+                "phase.time", attrs={"peers": len(qr.nodes())}
+            ):
                 self.tr.multicast(
                     tp.BATCH_TIME,
                     qr.nodes(),
@@ -388,7 +404,9 @@ class Client(Protocol):
                 except Exception as e:
                     return e
 
-            with metrics.timer("client.write_many.phase_sign"):
+            with metrics.timer("client.write_many.phase_sign"), trace.span(
+                "phase.sign", attrs={"peers": len(qa.nodes())}
+            ):
                 self.tr.multicast(
                     tp.BATCH_SIGN,
                     qa.nodes(),
@@ -424,7 +442,11 @@ class Client(Protocol):
                 jobs.append((tbss, ss))
                 jidx.append(i)
             if jobs:
-                with metrics.timer("client.write_many.phase_verify"):
+                with metrics.timer(
+                    "client.write_many.phase_verify"
+                ), trace.span(
+                    "verify.collective", attrs={"batch_size": len(jobs)}
+                ):
                     verrs = self.crypt.collective.verify_many(
                         jobs, qa, self.crypt.keyring
                     )
@@ -444,7 +466,9 @@ class Client(Protocol):
             ]
             qw = self.qs.choose_quorum(qm.WRITE)
             wtally = _BatchTally(len(pending), qw.is_threshold, qw.reject)
-            with metrics.timer("client.write_many.phase_write"):
+            with metrics.timer("client.write_many.phase_write"), trace.span(
+                "phase.write", attrs={"peers": len(qw.nodes())}
+            ):
                 self.tr.multicast(
                     tp.BATCH_WRITE,
                     qw.nodes(),
@@ -489,7 +513,9 @@ class Client(Protocol):
         ms: list[dict] = [{} for _ in range(n)]
         fails: list[list] = [[] for _ in range(n)]
 
-        with metrics.timer("client.read_many.latency"):
+        with metrics.timer("client.read_many.latency"), trace.span(
+            "client.read_many", attrs={"batch": n}
+        ):
 
             def cb(res: tp.MulticastResponse) -> bool:
                 if res.err is not None or res.data is None:
@@ -621,8 +647,9 @@ class Client(Protocol):
         latency but makes the outcome a function of the response SET,
         with the lone signed newest verified cryptographically
         (``_resolve_complete_fanout_many``)."""
-        with metrics.timer("client.read.latency"):
-            q = self.qs.choose_quorum(qm.READ)
+        with metrics.timer("client.read.latency"), trace.span("client.read"):
+            with trace.span("quorum.select"):
+                q = self.qs.choose_quorum(qm.READ)
             req = pkt.serialize(variable, None, 0, None, proof)
             ch: "queue.Queue[tuple[bytes | None, Exception | None]]" = (
                 queue.Queue(maxsize=1)
@@ -630,7 +657,7 @@ class Client(Protocol):
 
             worker = threading.Thread(
                 target=self._read_worker,
-                args=(q, req, ch, variable),
+                args=(q, req, ch, variable, trace.capture()),
                 daemon=True,
             )
             worker.start()
@@ -639,7 +666,15 @@ class Client(Protocol):
                 raise err
             return value
 
-    def _read_worker(self, q, req: bytes, ch, variable: bytes) -> None:
+    def _read_worker(
+        self, q, req: bytes, ch, variable: bytes, tctx=None
+    ) -> None:
+        # The fan-out runs on this worker thread; re-attach the read's
+        # trace context so per-peer rpc spans join the caller's trace.
+        with trace.attach(tctx):
+            self._read_worker_inner(q, req, ch, variable)
+
+    def _read_worker_inner(self, q, req: bytes, ch, variable: bytes) -> None:
         m: dict[int, dict[bytes, list[_SignedValue]]] = {}
         done = False
         value = None

@@ -36,6 +36,7 @@ from collections import OrderedDict
 from bftkv_tpu import packet as pkt
 from bftkv_tpu import quorum as qm
 from bftkv_tpu import storage as st
+from bftkv_tpu import trace
 from bftkv_tpu import transport as tp
 from bftkv_tpu.crypto import auth as authmod
 from bftkv_tpu.crypto import cert as certmod
@@ -95,7 +96,8 @@ class Server(Protocol):
     def _persist(self, variable: bytes, t: int, data: bytes) -> None:
         """All handler writes go through here: storage write + digest
         invalidation for the anti-entropy plane."""
-        self.storage.write(variable, t, data)
+        with trace.span("storage.write", attrs={"bytes": len(data)}):
+            self.storage.write(variable, t, data)
         tree = self._sync
         if tree is not None:
             tree.mark(variable)
@@ -131,6 +133,10 @@ class Server(Protocol):
         """decrypt → dispatch → encrypt.  Errors raise; the transport
         layer tunnels them back (x-error header / loopback raise)."""
         plain, sender, nonce = self.crypt.message.decrypt(data)
+        # The client's trace context rides a plaintext envelope inside
+        # the encrypted payload (packet.wrap_trace, prepended by the
+        # multicast fan-out); strip it before the handlers parse.
+        tctx, plain = pkt.unwrap_trace(plain)
         # "peer" is the sender as *we* know it — None on first contact
         # (the reference's nil peer, server.go:566-569).
         peer = self.crypt.keyring.get(sender.id)
@@ -138,11 +144,19 @@ class Server(Protocol):
         name = self._handlers.get(cmd)
         if name is None:
             raise ERR_UNKNOWN_COMMAND
-        metrics.incr(f"server.{tp.COMMAND_NAMES.get(cmd, cmd)}.count")
+        cmd_name = tp.COMMAND_NAMES.get(cmd, cmd)
+        metrics.incr(f"server.{cmd_name}.count")
         # Dispatch by name so subclasses (the Byzantine Mal* family,
         # reference: malserver_test.go:23-194) override handlers by
         # plain method definition.
-        res = getattr(self, name)(plain, peer, sender)
+        if tctx is not None:
+            with trace.attach(trace.SpanContext(*tctx)), trace.span(
+                f"server.{cmd_name}",
+                attrs={"node": getattr(self.self_node, "name", "")},
+            ):
+                res = getattr(self, name)(plain, peer, sender)
+        else:
+            res = getattr(self, name)(plain, peer, sender)
         return self.crypt.message.encrypt([sender], res or b"", nonce)
 
     # -- membership (reference: server.go:64-120) -------------------------
@@ -270,7 +284,11 @@ class Server(Protocol):
         # Verify the writer's signature with its own certificate.
         issuer = sigmod.issuer(sig, self.crypt.keyring)
         tbs = pkt.tbs(req)
-        sigmod.verify_with_certificate(tbs, sig, issuer)
+        with trace.span(
+            "server.verify_batch",
+            attrs={"batch_size": 1, "kind": "writer_sig"},
+        ):
+            sigmod.verify_with_certificate(tbs, sig, issuer)
         # The presented cert may carry a richer quorum certificate
         # than this replica's keyring copy; check against a transient
         # enriched view (never persisted — see _present).
@@ -410,9 +428,16 @@ class Server(Protocol):
 
         # Sufficient quorum members must have signed the same <x,v,t>.
         tbss = pkt.tbss(req)
-        self.crypt.collective.verify(
-            tbss, ss, self.qs.choose_quorum(qm.AUTH), self.crypt.keyring
-        )
+        with trace.span(
+            "server.verify_batch",
+            attrs={
+                "batch_size": len(sigmod.signers(ss)),
+                "kind": "collective",
+            },
+        ):
+            self.crypt.collective.verify(
+                tbss, ss, self.qs.choose_quorum(qm.AUTH), self.crypt.keyring
+            )
 
         out = self._write_storage_checks(variable, val, t, sig, ss, req)
         self._persist(variable, t, out)
@@ -883,11 +908,15 @@ class Server(Protocol):
         # One device batch for every writer signature in the request.
         if vitems:
             d = dispatch.get()
-            ok = (
-                d.verify(vitems)
-                if d is not None
-                else self.crypt.collective.verifier.verify_batch(vitems)
-            )
+            with trace.span(
+                "server.verify_batch",
+                attrs={"batch_size": len(vitems), "kind": "writer_sig"},
+            ):
+                ok = (
+                    d.verify(vitems)
+                    if d is not None
+                    else self.crypt.collective.verifier.verify_batch(vitems)
+                )
             for j, i in enumerate(vidx):
                 if not ok[j]:
                     results[i] = (_errstr(ERR_INVALID_SIGNATURE), b"")
@@ -1003,7 +1032,10 @@ class Server(Protocol):
                 results[i] = (_errstr(e), b"")
 
         if jobs:
-            with metrics.timer("server.batch_write.verify"):
+            with metrics.timer("server.batch_write.verify"), trace.span(
+                "server.verify_batch",
+                attrs={"batch_size": len(jobs), "kind": "collective"},
+            ):
                 verrs = self.crypt.collective.verify_many(
                     jobs, self.qs.choose_quorum(qm.AUTH), self.crypt.keyring
                 )

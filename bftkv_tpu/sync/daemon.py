@@ -40,6 +40,7 @@ import threading
 
 from bftkv_tpu import packet as pkt
 from bftkv_tpu import quorum as qm
+from bftkv_tpu import trace
 from bftkv_tpu import transport as tp
 from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.sync.digest import HIDDEN_PREFIX, latest_completed
@@ -105,9 +106,13 @@ def admit_records(server, records: list[bytes]) -> dict:
     # whole pull costs one kernel launch, not per-record host checks.
     if jobs:
         metrics.observe("sync.pull.verify_batch", len(jobs))
-        verrs = server.crypt.collective.verify_many(
-            jobs, server.qs.choose_quorum(qm.AUTH), server.crypt.keyring
-        )
+        with trace.span(
+            "server.verify_batch",
+            attrs={"batch_size": len(jobs), "kind": "sync_pull"},
+        ):
+            verrs = server.crypt.collective.verify_many(
+                jobs, server.qs.choose_quorum(qm.AUTH), server.crypt.keyring
+            )
     else:
         verrs = []
 
@@ -223,6 +228,12 @@ class SyncDaemon:
         round reaches every record some honest divergent peer serves;
         safety never depends on the count — admission re-verifies
         everything.  Returns aggregate counters."""
+        with trace.span("sync.round") as sp:
+            stats = self._run_round_inner()
+            sp.attrs.update(stats)
+        return stats
+
+    def _run_round_inner(self) -> dict:
         stats = {"peers": 0, "pulled_peers": 0, "admitted": 0,
                  "rejected": 0, "stale": 0}
         peers = self._peers()

@@ -1,0 +1,332 @@
+"""End-to-end request tracing: trace-id/span primitives, a bounded ring
+collector, and structured slow-request logging.
+
+The reference has no request correlation at all; ``metrics.py`` gives
+whole-process counters.  Neither can answer the questions that steer
+the store's performance work — *which replica* stalled a fan-out,
+*which phase* of a three-phase write burned the latency budget, *how
+full* the device verify batches actually ran (Thetacrypt ships
+per-request tracing through its threshold-crypto RPC layer for exactly
+this reason; "The Latency Price of Threshold Cryptosystems" shows the
+threshold path is dominated by stragglers only per-peer spans find).
+
+Deliberately dependency-free, same stance as :mod:`bftkv_tpu.metrics`:
+
+- a **span** is one timed operation (name, trace id, span id, parent
+  span id, start, duration, attrs).  ``span("client.write")`` is a
+  context manager; nesting on one thread parents automatically through
+  a thread-local stack;
+- **propagation** crosses threads and nodes explicitly: ``capture()``
+  snapshots the current context, ``attach(ctx)`` re-establishes it on
+  another thread, and the transport fan-out carries the context inside
+  the encrypted payload via the packet-level trace envelope
+  (:func:`bftkv_tpu.packet.wrap_trace`) so server-side spans join the
+  client's trace — including across processes over HTTP;
+- the **collector** is a bounded ring of finished spans (no
+  allocation growth under sustained traffic).  A *root* span (no
+  parent) finishing over the slow threshold snapshots its whole trace
+  into a separate slow ring and emits one JSON line on the
+  ``bftkv_tpu.trace.slow`` logger — grep-able, machine-parseable;
+- ``/trace`` on the daemon API serves the recent and slow rings.
+
+Span-name taxonomy and label-cardinality rules: docs/DESIGN.md §7.
+``BFTKV_TRACE=off`` disables collection (spans become no-ops and no
+trace context rides the wire); ``BFTKV_SLOW_TRACE_SECONDS`` sets the
+slow threshold (default 1.0).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import random
+import threading
+import time
+from collections import deque
+
+__all__ = [
+    "Span",
+    "SpanContext",
+    "Tracer",
+    "attach",
+    "capture",
+    "new_id",
+    "span",
+    "tracer",
+]
+
+slow_log = logging.getLogger("bftkv_tpu.trace.slow")
+
+# Trace/span ids are correlation handles, not secrets (they only ever
+# ride *inside* the encrypted transport envelope), so a seeded PRNG is
+# fine — and ~100x cheaper than os.urandom per span.
+_rng = random.Random(int.from_bytes(os.urandom(8), "big"))
+
+
+def new_id() -> int:
+    """A non-zero 63-bit id (0 is reserved as "absent" on the wire)."""
+    return _rng.getrandbits(63) | 1
+
+
+class SpanContext:
+    """What propagation carries: (trace_id, span_id) of the parent."""
+
+    __slots__ = ("trace_id", "span_id")
+
+    def __init__(self, trace_id: int, span_id: int):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+
+class Span:
+    __slots__ = (
+        "trace_id",
+        "span_id",
+        "parent_id",
+        "name",
+        "start",
+        "duration",
+        "attrs",
+        "_t0",
+    )
+
+    def __init__(self, trace_id, span_id, parent_id, name, attrs):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.start = time.time()
+        self.duration = 0.0
+        self.attrs = attrs
+        self._t0 = time.perf_counter()
+
+    def context(self) -> SpanContext:
+        return SpanContext(self.trace_id, self.span_id)
+
+    def to_dict(self) -> dict:
+        d = {
+            "trace": f"{self.trace_id:016x}",
+            "span": f"{self.span_id:016x}",
+            "name": self.name,
+            "start": self.start,
+            "duration": self.duration,
+        }
+        if self.parent_id is not None:
+            d["parent"] = f"{self.parent_id:016x}"
+        if self.attrs:
+            d["attrs"] = self.attrs
+        return d
+
+
+#: Sink for spans created while tracing is disabled: attrs writes land
+#: here and are discarded, so call sites never branch on enablement.
+_NULL_SPAN = Span(0, 0, None, "", {})
+
+_tls = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def capture() -> SpanContext | None:
+    """The current context — the innermost open span on this thread, or
+    the remotely attached context, or None.  What the transport layer
+    snapshots on the caller's thread before fanning out."""
+    if not tracer.enabled:
+        return None
+    st = getattr(_tls, "stack", None)
+    if st:
+        return st[-1].context()
+    return getattr(_tls, "remote", None)
+
+
+class attach:
+    """Re-establish a captured/propagated context on this thread, so
+    the next ``span()`` parents to it.  ``attach(None)`` is a no-op
+    shield (it masks any context leaked by a previous user of a pooled
+    thread).  Restores the previous context on exit."""
+
+    __slots__ = ("ctx", "_prev")
+
+    def __init__(self, ctx: SpanContext | None):
+        self.ctx = ctx
+
+    def __enter__(self) -> SpanContext | None:
+        self._prev = getattr(_tls, "remote", None)
+        _tls.remote = self.ctx
+        return self.ctx
+
+    def __exit__(self, *exc) -> bool:
+        _tls.remote = self._prev
+        return False
+
+
+class span:
+    """Context manager: one timed span, auto-parented.
+
+    Yields the :class:`Span` so callers can add attrs mid-flight
+    (``sp.attrs["batch_size"] = n``).  On exit the span is recorded in
+    the process tracer; an exception leaving the block lands in
+    ``attrs["error"]`` (interned error message when available) and
+    still propagates."""
+
+    __slots__ = ("name", "attrs", "_sp")
+
+    def __init__(self, name: str, attrs: dict | None = None):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self) -> Span:
+        if not tracer.enabled:
+            self._sp = None
+            return _NULL_SPAN
+        st = _stack()
+        if st:
+            parent = st[-1]
+            trace_id, parent_id = parent.trace_id, parent.span_id
+        else:
+            remote = getattr(_tls, "remote", None)
+            if remote is not None:
+                trace_id, parent_id = remote.trace_id, remote.span_id
+            else:
+                trace_id, parent_id = new_id(), None
+        sp = Span(trace_id, new_id(), parent_id, self.name,
+                  dict(self.attrs) if self.attrs else {})
+        st.append(sp)
+        self._sp = sp
+        return sp
+
+    def __exit__(self, etype, exc, tb) -> bool:
+        sp = self._sp
+        if sp is None:
+            return False
+        _stack().pop()
+        sp.duration = time.perf_counter() - sp._t0
+        if etype is not None:
+            msg = getattr(exc, "message", None)
+            sp.attrs["error"] = msg if isinstance(msg, str) else repr(exc)
+        tracer.record(sp)
+        return False
+
+
+class Tracer:
+    """Bounded ring collector + slow-trace capture.
+
+    ``max_spans`` bounds total retained spans (the ring IS the storage
+    — traces are grouped on demand); ``max_slow`` bounds retained slow
+    traces.  All methods are thread-safe; the span hot path is one
+    lock-guarded deque append."""
+
+    def __init__(
+        self,
+        max_spans: int = 8192,
+        slow_threshold: float | None = None,
+        max_slow: int = 64,
+    ):
+        self.enabled = os.environ.get("BFTKV_TRACE", "on").lower() not in (
+            "off", "0", "false",
+        )
+        if slow_threshold is None:
+            slow_threshold = float(
+                os.environ.get("BFTKV_SLOW_TRACE_SECONDS", "1.0")
+            )
+        self.slow_threshold = slow_threshold
+        self._lock = threading.Lock()
+        self._spans: "deque[Span]" = deque(maxlen=max_spans)
+        self._slow: "deque[dict]" = deque(maxlen=max_slow)
+
+    # -- recording --------------------------------------------------------
+
+    def record(self, sp: Span) -> None:
+        with self._lock:
+            self._spans.append(sp)
+        if sp.parent_id is None and sp.duration >= self.slow_threshold:
+            self._capture_slow(sp)
+
+    def _capture_slow(self, root: Span) -> None:
+        spans = self.trace(root.trace_id)
+        entry = {
+            "trace_id": f"{root.trace_id:016x}",
+            "root": root.name,
+            "duration": root.duration,
+            "start": root.start,
+            "spans": spans,
+        }
+        with self._lock:
+            self._slow.append(entry)
+        # One grep-able JSON line per slow request: the root, its
+        # duration, and a per-span breakdown compact enough for logs.
+        try:
+            slow_log.warning(json.dumps({
+                "event": "slow_request",
+                "trace_id": entry["trace_id"],
+                "root": root.name,
+                "duration_s": round(root.duration, 6),
+                "threshold_s": self.slow_threshold,
+                "spans": [
+                    {
+                        "name": s["name"],
+                        "duration_s": round(s["duration"], 6),
+                        **({"attrs": s["attrs"]} if s.get("attrs") else {}),
+                    }
+                    for s in spans
+                ],
+            }, default=str))
+        except Exception:  # a weird attr value must never kill a request
+            pass
+
+    # -- querying ---------------------------------------------------------
+
+    def trace(self, trace_id: int) -> list[dict]:
+        """Every retained span of one trace, oldest first."""
+        with self._lock:
+            return [
+                s.to_dict() for s in self._spans if s.trace_id == trace_id
+            ]
+
+    def traces(self, limit: int = 20) -> list[dict]:
+        """The most recent ``limit`` traces assembled from the ring
+        (newest last), each ``{"trace_id", "root", "duration", "spans"}``.
+        A trace whose root span already fell off the ring reports the
+        longest retained span as its root."""
+        with self._lock:
+            spans = [s.to_dict() for s in self._spans]
+        grouped: dict[str, list[dict]] = {}
+        order: list[str] = []
+        for s in spans:
+            tid = s["trace"]
+            if tid not in grouped:
+                grouped[tid] = []
+                order.append(tid)
+            grouped[tid].append(s)
+        out = []
+        for tid in order[-limit:]:
+            ss = grouped[tid]
+            root = next(
+                (s for s in ss if "parent" not in s),
+                max(ss, key=lambda s: s["duration"]),
+            )
+            out.append({
+                "trace_id": tid,
+                "root": root["name"],
+                "duration": root["duration"],
+                "spans": ss,
+            })
+        return out
+
+    def slow(self) -> list[dict]:
+        with self._lock:
+            return list(self._slow)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._slow.clear()
+
+
+tracer = Tracer()

@@ -28,7 +28,10 @@ import threading
 from dataclasses import dataclass
 from typing import Callable, Protocol
 
+from bftkv_tpu import packet as pkt
+from bftkv_tpu import trace
 from bftkv_tpu.errors import ERR_UNKNOWN_SESSION, new_error
+from bftkv_tpu.metrics import registry as metrics
 
 __all__ = [
     "JOIN",
@@ -56,6 +59,8 @@ __all__ = [
     "Transport",
     "TransportServer",
     "multicast",
+    "record_rpc",
+    "instrument_handler",
 ]
 
 # Command enum (reference: transport.go:14-28).
@@ -112,6 +117,45 @@ COMMAND_NAMES = {
     SYNC_PULL: "sync_pull",
 }
 COMMANDS_BY_NAME = {v: k for k, v in COMMAND_NAMES.items()}
+
+def record_rpc(
+    transport: str, side: str, cmd_name: str, n_in: int, n_out: int
+) -> None:
+    """Shared byte/RPC accounting for every transport backend, so
+    single-process (loopback/visual) clusters read the same
+    ``transport.*`` series a deployed HTTP fleet does.  One label set
+    per (transport, side, command) — all three dimensions are small
+    closed enums, so cardinality stays bounded (DESIGN.md §7).  Byte
+    directions are from the recording node's perspective."""
+    labels = {"transport": transport, "side": side, "cmd": cmd_name}
+    metrics.incr("transport.rpcs", labels=labels)
+    if n_in:
+        metrics.incr("transport.bytes_in", n_in, labels=labels)
+    if n_out:
+        metrics.incr("transport.bytes_out", n_out, labels=labels)
+
+
+def instrument_handler(transport: str, handler: Callable) -> Callable:
+    """Wrap a TransportServer handler with server-side
+    :func:`record_rpc` accounting — shared by every backend's server
+    seam (TrHTTP._dispatch, TrLoopback.start)."""
+
+    def instrumented(cmd: int, data: bytes) -> bytes | None:
+        res = None
+        try:
+            res = handler(cmd, data)
+            return res
+        finally:
+            record_rpc(
+                transport,
+                "server",
+                COMMAND_NAMES.get(cmd, str(cmd)),
+                len(data or b""),
+                len(res or b""),
+            )
+
+    return instrumented
+
 
 ERR_TRANSPORT_SECURITY = new_error("transport: transport security error")
 ERR_NONCE_MISMATCH = new_error("transport: nonce mismatch")
@@ -234,6 +278,13 @@ def multicast(
     name = COMMAND_NAMES.get(cmd)
     if name is None:
         raise new_error("transport: unknown command")
+    # Snapshot the caller's trace context ONCE: encryption happens on
+    # this thread (single-payload mode encrypts once for all peers, so
+    # per-peer parents are impossible by construction) and the context
+    # rides INSIDE the encrypted payload (packet.wrap_trace).  Server
+    # spans parent to the caller's phase span; the per-peer rpc spans
+    # below are its siblings.
+    ctx = trace.capture()
     ch: "queue.Queue[MulticastResponse]" = queue.Queue()
     cipher = None
     nonce = None
@@ -243,6 +294,8 @@ def multicast(
         if i < len(mdata):
             nonce = tr.generate_random()
             payload = mdata[i] or b""
+            if ctx is not None:
+                payload = pkt.wrap_trace(ctx.trace_id, ctx.span_id, payload)
             try:
                 recipients = peers[i : i + len(peers) - len(mdata) + 1]
                 cipher = tr.encrypt(recipients, payload, nonce)
@@ -256,40 +309,17 @@ def multicast(
             if not addr:
                 ch.put(MulticastResponse(peer, None, ERR_NO_ADDRESS()))
                 return
-            try:
-                try:
-                    res = tr.post(addr + PREFIX + name, cipher)
-                    plain, _sender, echoed = tr.decrypt(res)
-                except ERR_UNKNOWN_SESSION:
-                    # The peer does not hold the session this envelope
-                    # used: restart, cache eviction, or our fast-path
-                    # envelope overtook its establishing bootstrap.
-                    # Retry once with a *forced* bootstrap for this peer
-                    # alone — self-contained, decryptable regardless of
-                    # the peer's session state.
-                    sec = getattr(tr, "security", None)
-                    if sec is None:
-                        raise
-                    sec.message.invalidate(peer.id)
-                    nonce2 = tr.generate_random()
-                    cipher2 = sec.message.encrypt(
-                        [peer], payload, nonce2, force_bootstrap=True
-                    )
-                    res = tr.post(addr + PREFIX + name, cipher2)
-                    plain, _sender, echoed = tr.decrypt(res)
-                    if echoed != nonce2:
-                        ch.put(
-                            MulticastResponse(peer, None, ERR_NONCE_MISMATCH())
-                        )
-                        return
-                    ch.put(MulticastResponse(peer, plain, None))
-                    return
-                if echoed != nonce:
-                    ch.put(MulticastResponse(peer, None, ERR_NONCE_MISMATCH()))
-                    return
-                ch.put(MulticastResponse(peer, plain, None))
-            except Exception as e:
-                ch.put(MulticastResponse(peer, None, e))
+            if ctx is None:
+                _post_one(tr, name, peer, addr, cipher, nonce, payload, ch)
+                return
+            # Pool workers are reused across requests: attach() both
+            # parents this span to the captured context and shields the
+            # thread from any context a previous task leaked.
+            with trace.attach(ctx), trace.span(
+                f"rpc.{name}",
+                attrs={"peer": getattr(peer, "name", "") or addr},
+            ):
+                _post_one(tr, name, peer, addr, cipher, nonce, payload, ch)
 
         _pool.submit(work)
         launched += 1
@@ -298,3 +328,40 @@ def multicast(
         mr = ch.get()
         if cb is not None and cb(mr):
             break  # early exit; remaining posts finish in their threads
+
+
+def _post_one(tr, name, peer, addr, cipher, nonce, payload, ch) -> None:
+    """One peer's post → decrypt → nonce check (the body of the fan-out
+    worker, split out so the traced and untraced paths share it)."""
+    try:
+        try:
+            res = tr.post(addr + PREFIX + name, cipher)
+            plain, _sender, echoed = tr.decrypt(res)
+        except ERR_UNKNOWN_SESSION:
+            # The peer does not hold the session this envelope
+            # used: restart, cache eviction, or our fast-path
+            # envelope overtook its establishing bootstrap.
+            # Retry once with a *forced* bootstrap for this peer
+            # alone — self-contained, decryptable regardless of
+            # the peer's session state.
+            sec = getattr(tr, "security", None)
+            if sec is None:
+                raise
+            sec.message.invalidate(peer.id)
+            nonce2 = tr.generate_random()
+            cipher2 = sec.message.encrypt(
+                [peer], payload, nonce2, force_bootstrap=True
+            )
+            res = tr.post(addr + PREFIX + name, cipher2)
+            plain, _sender, echoed = tr.decrypt(res)
+            if echoed != nonce2:
+                ch.put(MulticastResponse(peer, None, ERR_NONCE_MISMATCH()))
+                return
+            ch.put(MulticastResponse(peer, plain, None))
+            return
+        if echoed != nonce:
+            ch.put(MulticastResponse(peer, None, ERR_NONCE_MISMATCH()))
+            return
+        ch.put(MulticastResponse(peer, plain, None))
+    except Exception as e:
+        ch.put(MulticastResponse(peer, None, e))

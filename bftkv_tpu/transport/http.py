@@ -91,9 +91,12 @@ class TrHTTP:
             headers={"content-type": "application/octet-stream"},
             method="POST",
         )
+        cmd_name = addr.rsplit("/", 1)[-1]
         try:
             with urllib.request.urlopen(req, timeout=RESPONSE_TIMEOUT) as res:
-                return res.read()
+                body = res.read()
+            tp.record_rpc("http", "client", cmd_name, len(body), len(msg or b""))
+            return body
         except urllib.error.HTTPError as e:
             errs = e.headers.get("x-error") if e.headers else None
             e.close()
@@ -127,7 +130,7 @@ class TrHTTP:
         self._thread.start()
 
     def _dispatch(self, o):
-        return o.handler
+        return tp.instrument_handler("http", o.handler)
 
     def stop(self) -> None:
         if self._server is not None:

@@ -51,7 +51,9 @@ class TrLoopback:
         cmd = tp.COMMANDS_BY_NAME.get(name)
         if cmd is None:
             raise ERR_UNREACHABLE
-        return handler(cmd, msg) or b""
+        res = handler(cmd, msg) or b""
+        tp.record_rpc("loop", "client", name, len(res), len(msg or b""))
+        return res
 
     def multicast(self, cmd: int, peers: list, data: bytes | None, cb) -> None:
         tp.multicast(self, cmd, peers, [data], cb)
@@ -62,7 +64,10 @@ class TrLoopback:
     # -- server side ------------------------------------------------------
     def start(self, o, addr: str) -> None:
         self._addr = addr
-        self.net.register(addr, o.handler)
+        # Same transport.* accounting as TrHTTP._dispatch, so
+        # single-process cluster tests see the byte/RPC series a
+        # deployed fleet exports.
+        self.net.register(addr, tp.instrument_handler("loop", o.handler))
 
     def stop(self) -> None:
         if self._addr is not None:

@@ -25,6 +25,7 @@ import struct
 import threading
 
 from bftkv_tpu import transport as tp
+from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.transport.http import TrHTTP
 
 __all__ = ["TrVisual", "WsHub"]
@@ -157,15 +158,30 @@ class WsHub(socketserver.ThreadingTCPServer):
 
     def push(self, event: dict) -> None:
         frame = _frame_text(json.dumps(event).encode())
+        sent = 0
         with self._lock:
             dead = []
             for c in self._clients:
                 try:
                     c.sendall(frame)
+                    sent += 1
                 except OSError:
                     dead.append(c)
             for c in dead:
                 self._clients.discard(c)
+        # The ws feed is a one-way broadcast, so "bytes_out per event
+        # type" is its whole transport story (the RPC legs underneath
+        # are already counted by the inherited TrHTTP instrumentation).
+        labels = {"transport": "ws", "event": str(event.get("type", "?"))}
+        metrics.incr("transport.ws.events", labels=labels)
+        if sent:
+            # Own family (not transport.bytes_out): its label schema is
+            # per-event, not record_rpc's (transport, side, cmd).
+            metrics.incr(
+                "transport.ws.bytes_out", sent * len(frame), labels=labels
+            )
+        if dead:
+            metrics.incr("transport.ws.dropped_clients", len(dead))
 
     def stop(self) -> None:
         self.shutdown()

@@ -140,8 +140,60 @@ def test_daemon_show_and_metrics(cluster):
     ) as res:
         import json
 
+        assert res.headers.get("content-type", "").startswith(
+            "application/json"
+        )
         snap = json.loads(res.read())
     assert isinstance(snap, dict)
+    # Content negotiation: a Prometheus scraper's Accept header gets
+    # text exposition from the same endpoint.
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{API_BASE}/metrics",
+        headers={"accept": "text/plain"},
+    )
+    with urllib.request.urlopen(req, timeout=30) as res:
+        assert res.headers.get("content-type", "").startswith("text/plain")
+        prom = res.read().decode()
+    assert "# TYPE" in prom
+    assert "_total" in prom  # counters end in _total
+    # ?format=prometheus works without the header (curl-friendly)
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{API_BASE}/metrics?format=prometheus", timeout=30
+    ) as res:
+        assert res.read().decode().startswith("# TYPE")
+
+
+def test_daemon_trace_endpoint(cluster):
+    import json
+
+    # Drive one write through the daemon's client so a trace exists.
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{API_BASE}/write/smoke/traced", data=b"t",
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=60) as res:
+        assert res.status == 200
+    # Straggler fan-out workers may still be recording rpc spans right
+    # after the write returns; poll until the trace settles.
+    deadline = time.monotonic() + 30
+    names: list = []
+    while time.monotonic() < deadline:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{API_BASE}/trace?limit=50", timeout=30
+        ) as res:
+            doc = json.loads(res.read())
+        assert set(doc) == {"slow_threshold_s", "slow", "recent"}
+        roots = [t for t in doc["recent"] if t["root"] == "client.write"]
+        if roots:
+            names = [s["name"] for s in roots[-1]["spans"]]
+            if (
+                "quorum.select" in names
+                and sum(1 for n in names if n.startswith("rpc.")) >= 3
+            ):
+                break
+        time.sleep(0.5)
+    assert "quorum.select" in names, names
+    assert sum(1 for n in names if n.startswith("rpc.")) >= 3, names
 
 
 @pytest.mark.slow  # tier-2: heavy on a small-CPU tier-1 box (see pytest.ini)

@@ -1,0 +1,229 @@
+"""End-to-end request tracing (bftkv_tpu/trace.py): span primitives,
+packet-envelope propagation, and the full client-write span tree over a
+loopback cluster — the observability layer's acceptance gate."""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+
+import pytest
+
+from bftkv_tpu import packet as pkt
+from bftkv_tpu import trace
+from cluster_utils import start_cluster
+
+
+def wait_trace(root_name: str, pred, timeout: float = 10.0) -> dict:
+    """Newest trace with the given root once ``pred(trace)`` holds.
+
+    The multicast early-exit leaves straggler fan-out workers finishing
+    their rpc/server spans AFTER the client call returned, so a trace
+    assembled immediately can be mid-flight; poll until it settles."""
+    deadline = time.monotonic() + timeout
+    last = None
+    while True:
+        roots = [
+            t
+            for t in trace.tracer.traces(limit=50)
+            if t["root"] == root_name
+        ]
+        if roots:
+            last = roots[-1]
+            if pred(last):
+                return last
+        if time.monotonic() > deadline:
+            assert last is not None, f"no {root_name} trace collected"
+            return last
+        time.sleep(0.05)
+
+
+def dangling_parents(t: dict) -> list:
+    ids = {s["span"] for s in t["spans"]}
+    return [s for s in t["spans"] if "parent" in s and s["parent"] not in ids]
+
+
+# -- primitives -------------------------------------------------------------
+
+
+def test_span_nesting_parents_on_one_thread():
+    trace.tracer.reset()
+    with trace.span("outer") as outer:
+        with trace.span("inner") as inner:
+            assert inner.trace_id == outer.trace_id
+            assert inner.parent_id == outer.span_id
+    assert outer.parent_id is None
+    spans = trace.tracer.trace(outer.trace_id)
+    assert [s["name"] for s in spans] == ["inner", "outer"]
+
+
+def test_capture_attach_crosses_threads():
+    trace.tracer.reset()
+    seen = {}
+
+    def worker(ctx):
+        with trace.attach(ctx), trace.span("remote.child") as sp:
+            seen["trace_id"] = sp.trace_id
+            seen["parent_id"] = sp.parent_id
+
+    with trace.span("root") as root:
+        ctx = trace.capture()
+        t = threading.Thread(target=worker, args=(ctx,))
+        t.start()
+        t.join()
+    assert seen["trace_id"] == root.trace_id
+    assert seen["parent_id"] == root.span_id
+
+
+def test_attach_none_shields_leaked_context():
+    trace.tracer.reset()
+    with trace.span("root"):
+        with trace.attach(None):
+            # Stack still wins over remote; but a fresh thread-style
+            # context (empty stack) must see no remote either.
+            assert trace.capture() is not None  # stack top
+    # outside any span: no context
+    assert trace.capture() is None
+
+
+def test_trace_envelope_roundtrip_and_passthrough():
+    tid, sid = trace.new_id(), trace.new_id()
+    payload = pkt.serialize(b"x", b"v", 7)
+    wrapped = pkt.wrap_trace(tid, sid, payload)
+    ctx, out = pkt.unwrap_trace(wrapped)
+    assert ctx == (tid, sid)
+    assert out == payload
+    # the inner payload parses identically after the round trip
+    p = pkt.parse(out)
+    assert (p.variable, p.value, p.t) == (b"x", b"v", 7)
+    # a bare packet passes through untouched: its first envelope byte
+    # is a length-prefix 0x00, never the 0xff magic
+    ctx2, out2 = pkt.unwrap_trace(payload)
+    assert ctx2 is None and out2 == payload
+
+
+def test_slow_trace_capture_and_json_log(caplog):
+    t = trace.Tracer(slow_threshold=0.0)  # everything is "slow"
+    old, trace.tracer = trace.tracer, t
+    try:
+        with caplog.at_level(logging.WARNING, logger="bftkv_tpu.trace.slow"):
+            with trace.span("slow.root"):
+                with trace.span("slow.child", attrs={"batch_size": 3}):
+                    pass
+        slow = t.slow()
+        assert len(slow) == 1
+        assert slow[0]["root"] == "slow.root"
+        names = [s["name"] for s in slow[0]["spans"]]
+        assert names == ["slow.child", "slow.root"]
+        # exactly one structured JSON line, machine-parseable
+        lines = [r.message for r in caplog.records]
+        assert len(lines) == 1
+        doc = json.loads(lines[0])
+        assert doc["event"] == "slow_request"
+        assert doc["root"] == "slow.root"
+        assert any(
+            s.get("attrs", {}).get("batch_size") == 3 for s in doc["spans"]
+        )
+    finally:
+        trace.tracer = old
+
+
+def test_error_lands_in_span_attrs():
+    trace.tracer.reset()
+    with pytest.raises(ValueError):
+        with trace.span("boom") as sp:
+            raise ValueError("nope")
+    assert "error" in sp.attrs
+
+
+# -- the acceptance gate: one write, one trace, the full span tree ----------
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = start_cluster(4, 1, 4, bits=1024)
+    yield c
+    c.stop()
+
+
+def test_write_trace_spans_loopback_cluster(cluster):
+    trace.tracer.reset()
+    cluster.clients[0].write(b"traced/x", b"value-1")
+
+    def settled(t):
+        names = [s["name"] for s in t["spans"]]
+        return (
+            sum(1 for n in names if n.startswith("rpc.")) >= 3
+            and "server.verify_batch" in names
+            and "storage.write" in names
+        )
+
+    t = wait_trace("client.write", settled)
+    spans = t["spans"]
+    names = [s["name"] for s in spans]
+
+    # one trace id covers everything
+    assert {s["trace"] for s in spans} == {t["trace_id"]}
+    # quorum selection
+    assert "quorum.select" in names
+    # the three client phases
+    for phase in ("phase.time", "phase.sign", "phase.write"):
+        assert phase in names
+    # >= 3 per-peer fan-out RPCs (4 quorum servers)
+    assert sum(1 for n in names if n.startswith("rpc.")) >= 3
+    # server-side admission joined the SAME trace across the envelope
+    assert any(n.startswith("server.") for n in names)
+    # verify-batch spans carry the batch-size attribute
+    vb = [s for s in spans if s["name"] == "server.verify_batch"]
+    assert vb
+    assert all("batch_size" in s.get("attrs", {}) for s in vb)
+    # the storage op made it in
+    assert "storage.write" in names
+
+
+def test_write_trace_parent_edges_resolve(cluster):
+    """Every non-root span's parent is another span of the same trace —
+    the tree reassembles without dangling edges (single-process
+    loopback: all nodes share the collector)."""
+    trace.tracer.reset()
+    cluster.clients[0].write(b"traced/y", b"value-2")
+    t = wait_trace("client.write", lambda t: not dangling_parents(t))
+    assert not dangling_parents(t), dangling_parents(t)
+
+
+def test_read_trace_spans(cluster):
+    cluster.clients[0].write(b"traced/r", b"value-r")  # self-contained
+    trace.tracer.reset()
+    assert cluster.clients[0].read(b"traced/r") == b"value-r"
+
+    def settled(t):
+        names = [s["name"] for s in t["spans"]]
+        return (
+            sum(1 for n in names if n == "rpc.read") >= 3
+            and "server.read" in names
+        )
+
+    t = wait_trace("client.read", settled)
+    names = [s["name"] for s in t["spans"]]
+    assert "quorum.select" in names
+    assert sum(1 for n in names if n == "rpc.read") >= 3
+    assert "server.read" in names
+
+
+def test_trace_disabled_sends_no_envelope(cluster, monkeypatch):
+    """BFTKV_TRACE=off: spans are no-ops, no context rides the wire,
+    and the protocol still works."""
+    monkeypatch.setattr(trace.tracer, "enabled", False)
+    trace.tracer.reset()
+    cluster.clients[0].write(b"traced/off", b"v")
+    assert cluster.clients[0].read(b"traced/off") == b"v"
+    # No client roots collected for the disabled operations (straggler
+    # worker spans from the PREVIOUS enabled test may still trickle in
+    # after reset(), so assert on the roots, not on emptiness).
+    assert not any(
+        s["name"] in ("client.write", "client.read")
+        for t in trace.tracer.traces(limit=50)
+        for s in t["spans"]
+    )
