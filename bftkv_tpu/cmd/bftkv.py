@@ -49,7 +49,12 @@ def build_server(args):
     if args.storage == "plain":
         from bftkv_tpu.storage.plain import PlainStorage
 
-        storage = PlainStorage(args.db)
+        # The daemon is durable by default (fsync file + dir per
+        # write); BFTKV_PLAIN_FSYNC=0 opts a deployment out.
+        storage = PlainStorage(
+            args.db,
+            fsync=os.environ.get("BFTKV_PLAIN_FSYNC", "1") != "0",
+        )
     elif args.storage == "native":
         from bftkv_tpu.storage.native import NativeStorage
 
@@ -87,7 +92,7 @@ def build_server(args):
         tr = TrVisual(crypt, hub, graph)
         print(f"bftkv: visualizer feed @ ws://{host or '127.0.0.1'}:{port}")
     else:
-        tr = TrHTTP(crypt)
+        tr = TrHTTP(crypt, rpc_timeout=args.rpc_timeout)
     server = Server(graph, qs, tr, crypt, storage)
     return server, graph, crypt, qs, tr
 
@@ -328,6 +333,18 @@ def main(argv: list[str] | None = None) -> int:
                          "root span exceeds it is kept on /trace and "
                          "logged as one JSON line (default from "
                          "BFTKV_SLOW_TRACE_SECONDS, else 1.0)")
+    ap.add_argument("--rpc-timeout", type=float, default=None,
+                    metavar="SECONDS",
+                    help="per-RPC response deadline for inter-replica "
+                         "calls (default from BFTKV_RPC_TIMEOUT / "
+                         "BFTKV_HTTP_TIMEOUT, else 10)")
+    ap.add_argument("--chaos-seed", type=int, default=None, metavar="N",
+                    help="TESTING: arm the deterministic failpoint "
+                         "registry with this seed and install the "
+                         "default chaos program (seeded transport "
+                         "delays/drops + sync-round aborts; "
+                         "bftkv_tpu.faults). Same seed => same fault "
+                         "schedule every run")
     ap.add_argument("--dispatch", action="store_true",
                     help="install the TPU verify/sign dispatchers "
                          "(one replica process per accelerator)")
@@ -362,6 +379,14 @@ def main(argv: list[str] | None = None) -> int:
         from bftkv_tpu import trace as trmod
 
         trmod.tracer.slow_threshold = args.slow_trace
+    if args.chaos_seed is not None:
+        from bftkv_tpu import faults
+
+        faults.default_chaos_program(faults.arm(args.chaos_seed))
+        print(
+            f"bftkv: CHAOS armed, seed={args.chaos_seed} "
+            "(deterministic failpoint program)", flush=True,
+        )
 
     server, graph, crypt, qs, tr = build_server(args)
 
@@ -417,7 +442,9 @@ def main(argv: list[str] | None = None) -> int:
         from bftkv_tpu.transport.http import TrHTTP
 
         cgraph, ccrypt, cqs = topology.load_home(args.client_home)
-        client = Client(cgraph, cqs, TrHTTP(ccrypt), ccrypt)
+        client = Client(
+            cgraph, cqs, TrHTTP(ccrypt, rpc_timeout=args.rpc_timeout), ccrypt
+        )
     else:
         client = Client(graph, qs, tr, crypt)
     if args.join:

@@ -46,6 +46,8 @@ def spawn(
     verify_sidecar: str = "",
     anti_entropy: float = 0.0,
     slow_trace: float | None = None,
+    rpc_timeout: float | None = None,
+    chaos_seed: int | None = None,
     extra_env: dict | None = None,
 ) -> list[subprocess.Popen]:
     """``verify_sidecar``: "auto" spawns one shared sidecar process and
@@ -97,6 +99,12 @@ def spawn(
             cmd += ["--anti-entropy", str(anti_entropy)]
         if slow_trace is not None:
             cmd += ["--slow-trace", str(slow_trace)]
+        if rpc_timeout is not None:
+            cmd += ["--rpc-timeout", str(rpc_timeout)]
+        if chaos_seed is not None:
+            # seed + index: each daemon's schedule is reproducible run
+            # to run but the fleet does not fire faults in lockstep.
+            cmd += ["--chaos-seed", str(chaos_seed + i)]
         procs.append(subprocess.Popen(cmd, env=env))
     return procs
 
@@ -139,6 +147,15 @@ def main(argv: list[str] | None = None) -> int:
                     metavar="SECONDS",
                     help="per-daemon slow-request trace threshold "
                          "(see bftkv --help)")
+    ap.add_argument("--rpc-timeout", type=float, default=None,
+                    metavar="SECONDS",
+                    help="per-daemon per-RPC response deadline "
+                         "(see bftkv --help)")
+    ap.add_argument("--chaos-seed", type=int, default=None, metavar="N",
+                    help="TESTING: arm every daemon's deterministic "
+                         "failpoint registry (daemon i gets seed N+i); "
+                         "same N replays the same fleet-wide fault "
+                         "schedule (see bftkv --help)")
     args = ap.parse_args(argv)
 
     homes = server_homes(args.keys)
@@ -150,7 +167,9 @@ def main(argv: list[str] | None = None) -> int:
                   bind_host=args.bind_host, client_home=args.client_home,
                   verify_sidecar=args.verify_sidecar,
                   anti_entropy=args.anti_entropy,
-                  slow_trace=args.slow_trace)
+                  slow_trace=args.slow_trace,
+                  rpc_timeout=args.rpc_timeout,
+                  chaos_seed=args.chaos_seed)
     # The sidecar (if spawned, always first) is an optional optimizer
     # whose clients fall back to local verification: its death must not
     # tear down the replica fleet, and it is not a "server".

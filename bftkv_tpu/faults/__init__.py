@@ -1,0 +1,71 @@
+"""Deterministic fault injection: failpoints, chaos nemesis, safety checker.
+
+The paper's whole claim is tolerating ``f`` Byzantine replicas out of
+``3f+1``, but a claim is only as strong as the adversary that tested it.
+This package is that adversary, in three layers:
+
+- :mod:`bftkv_tpu.faults.failpoint` — a **seeded, deterministic
+  failpoint registry**.  Named hook points are woven into the transport
+  fan-out (drop / delay / duplicate / corrupt, per-link), server
+  admission (error reply, crash, Byzantine handler override), storage
+  (I/O error, torn write), the batching dispatcher (flush stall), the
+  timestamp path (clock skew), and the anti-entropy daemon (round
+  abort).  Disarmed, every hook is a single module-bool test — the
+  production path pays nothing.  Armed, every probabilistic decision is
+  a counter-indexed hash of one seed, so a fault schedule replays
+  identically run to run.
+- :mod:`bftkv_tpu.faults.nemesis` — timed chaos schedules against an
+  in-process loopback cluster: healing link-matrix partitions,
+  crash-restart onto the same storage (anti-entropy must converge the
+  replica back), clock skew, and Byzantine modes (collusion, stale
+  replay) expressed as failpoint programs instead of subclasses.
+  ``python -m bftkv_tpu.faults.nemesis --seed 7`` runs one seeded round.
+- :mod:`bftkv_tpu.faults.checker` — a history recorder plus the
+  invariants every chaos run must keep: write-once variables never
+  change, per-variable timestamps are monotonic at honest replicas,
+  every successful read is backed by a sufficient collective signature,
+  and no two conflicting values both gather ``2f+1`` acks.
+
+Byzantine handler programs live in :mod:`bftkv_tpu.faults.byzantine`;
+``tests/mal_utils.py`` keeps its subclass API as a shim over them, so
+hand-written Byzantine tests and chaos runs share one mechanism.
+"""
+
+from bftkv_tpu.faults.failpoint import (
+    Action,
+    FaultEvent,
+    FaultRegistry,
+    Rule,
+    arm,
+    disarm,
+    fire,
+    registry,
+)
+
+__all__ = [
+    "Action",
+    "FaultEvent",
+    "FaultRegistry",
+    "Rule",
+    "arm",
+    "disarm",
+    "fire",
+    "registry",
+    "default_chaos_program",
+]
+
+
+def default_chaos_program(reg: FaultRegistry) -> list:
+    """The light background chaos a daemon arms under ``--chaos-seed``:
+    seeded transport delays and rare drops plus occasional anti-entropy
+    round aborts.  Deliberately inside the ``f`` budget — a fleet under
+    this program must stay fully correct, only slower."""
+    return [
+        reg.add(
+            "transport.send", "delay",
+            prob=0.10, seconds=0.01, max_seconds=0.05,
+            rule_id="default:delay",
+        ),
+        reg.add("transport.send", "drop", prob=0.02, rule_id="default:drop"),
+        reg.add("sync.round", "abort", prob=0.10, rule_id="default:abort"),
+    ]

@@ -1,0 +1,328 @@
+"""Chaos safety checker: history recorder + BFT invariants.
+
+The nemesis runs faults; this module decides whether the run *meant*
+anything.  A :class:`HistoryRecorder` collects two event streams while
+chaos runs:
+
+- **client ops** — the harness records every write / write-once / read
+  outcome observed by honest clients;
+- **replica persists** — each replica's storage is wrapped in a
+  :class:`RecordingStorage` that notes every stored protocol record
+  (variable, t, value, completed?) per node.  Observation lives in the
+  harness wrapper, not in a core hook: the store under test runs
+  unmodified.
+
+After the run :class:`SafetyChecker` evaluates the paper's safety
+contract over the whole history plus the replicas' final state:
+
+1. **Write-once immutability** — a variable committed with
+   ``write_once`` never reads back as anything else, and no honest
+   replica ever persists a different completed value at ``t = 2^64-1``.
+2. **Timestamp monotonicity at honest replicas** — the sequence of
+   completed records an honest replica persists for one variable never
+   goes back in time (Byzantine replicas are exempt: they may store
+   anything, the point is that it must not matter).
+3. **Read integrity** — every successful read's value is backed by a
+   record carrying a *sufficient collective signature* that actually
+   verifies against an honest replica's quorum and keyring.  A value
+   no sign quorum endorsed appearing at a reader is the smoking gun of
+   a safety violation, whatever path it took.
+4. **No conflicting commits** — no two different values at the same
+   ``(variable, t)`` are each persisted by ``2f+1`` distinct replicas:
+   two such sets would both intersect every quorum in an honest
+   replica that acked both, which the equivocation checks forbid.
+
+Liveness is deliberately NOT checked: during a partition, failing
+writes is the *correct* behavior.  Failures are recorded (the nemesis
+reports them) but only safety violations fail a run.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable
+
+from bftkv_tpu import packet as pkt
+from bftkv_tpu import quorum as qm
+from bftkv_tpu.protocol import MAX_UINT64
+from bftkv_tpu.sync.digest import HIDDEN_PREFIX
+
+__all__ = [
+    "Event",
+    "HistoryRecorder",
+    "RecordingStorage",
+    "SafetyChecker",
+]
+
+
+class Event:
+    """One history entry; ``kind`` ∈ {persist, write_ok, write_once_ok,
+    write_fail, read_ok, read_fail}."""
+
+    __slots__ = ("seq", "kind", "fields")
+
+    def __init__(self, seq: int, kind: str, fields: dict):
+        self.seq = seq
+        self.kind = kind
+        self.fields = fields
+
+    def __getattr__(self, name):
+        try:
+            return self.fields[name]
+        except KeyError:
+            raise AttributeError(name) from None
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"Event({self.seq}, {self.kind}, {self.fields})"
+
+
+class HistoryRecorder:
+    """Thread-safe append-only history; one global sequence."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: list[Event] = []
+        self._seq = 0
+
+    def record(self, kind: str, **fields) -> None:
+        with self._lock:
+            self._seq += 1
+            self._events.append(Event(self._seq, kind, fields))
+
+    def events(self, kind: str | None = None) -> list[Event]:
+        with self._lock:
+            evs = list(self._events)
+        if kind is None:
+            return evs
+        return [e for e in evs if e.kind == kind]
+
+    # -- harness conveniences --------------------------------------------
+
+    def write_ok(self, client: str, variable: bytes, value: bytes) -> None:
+        self.record("write_ok", client=client, variable=variable, value=value)
+
+    def write_once_ok(
+        self, client: str, variable: bytes, value: bytes
+    ) -> None:
+        self.record(
+            "write_once_ok", client=client, variable=variable, value=value
+        )
+
+    def write_fail(
+        self, client: str, variable: bytes, err: Exception
+    ) -> None:
+        self.record("write_fail", client=client, variable=variable, err=err)
+
+    def read_ok(
+        self, client: str, variable: bytes, value: bytes | None
+    ) -> None:
+        self.record("read_ok", client=client, variable=variable, value=value)
+
+    def read_fail(self, client: str, variable: bytes, err: Exception) -> None:
+        self.record("read_fail", client=client, variable=variable, err=err)
+
+
+class RecordingStorage:
+    """Storage wrapper: delegates everything, records protocol persists.
+
+    Wrap a replica's storage *before* the server touches it (the sync
+    digest tree captures ``server.storage`` lazily).  Survives
+    crash-restart by construction — the nemesis hands the same wrapper
+    to the restarted server, which is exactly "the same storage dir".
+    """
+
+    def __init__(
+        self, inner, node: str, recorder: HistoryRecorder, honest: bool = True
+    ):
+        self.inner = inner
+        self.node = node
+        self.recorder = recorder
+        self.honest = honest
+
+    # -- storage contract -------------------------------------------------
+
+    def read(self, variable: bytes, t: int = 0) -> bytes:
+        return self.inner.read(variable, t)
+
+    def versions(self, variable: bytes) -> list[int]:
+        return self.inner.versions(variable)
+
+    def keys(self) -> list[bytes]:
+        return self.inner.keys()
+
+    def scan(self) -> list[tuple[bytes, int]]:
+        return self.inner.scan()
+
+    def write(self, variable: bytes, t: int, value: bytes) -> None:
+        self.inner.write(variable, t, value)
+        if variable.startswith(HIDDEN_PREFIX):
+            return  # threshold-CA shares: not protocol records
+        completed = False
+        pvalue = None
+        try:
+            p = pkt.parse(value)
+            pvalue = p.value
+            completed = p.ss is not None and p.ss.completed
+        except Exception:
+            pass  # non-record bytes (mal tests): recorded as incomplete
+        self.recorder.record(
+            "persist",
+            node=self.node,
+            honest=self.honest,
+            variable=variable,
+            t=t,
+            value=pvalue,
+            completed=completed,
+        )
+
+    # MalStorage pass-through so byzantine programs keep their side area.
+    def mal_write(self, variable: bytes, t: int, value: bytes) -> None:
+        mw = getattr(self.inner, "mal_write", None)
+        if mw is not None:
+            mw(variable, t, value)
+        else:
+            self.inner.write(variable, t, value)
+
+
+class SafetyChecker:
+    """Evaluates the four invariants over a recorded history."""
+
+    def __init__(self, recorder: HistoryRecorder, f: int):
+        self.recorder = recorder
+        self.f = f
+
+    def check(self, honest_servers: Iterable) -> list[str]:
+        """Returns human-readable violations (empty = safe run).
+        ``honest_servers``: the honest replica Server objects, used for
+        final-state lookups and collective-signature verification."""
+        servers = list(honest_servers)
+        out: list[str] = []
+        out += self._check_write_once(servers)
+        out += self._check_monotonic()
+        out += self._check_read_integrity(servers)
+        out += self._check_conflicting_commits()
+        return out
+
+    # -- 1. write-once immutability --------------------------------------
+
+    def _check_write_once(self, servers) -> list[str]:
+        out = []
+        expected: dict[bytes, bytes] = {}
+        for e in self.recorder.events():
+            if e.kind == "write_once_ok":
+                var, val = e.variable, e.value
+                if var in expected and expected[var] != val:
+                    out.append(
+                        f"write-once {var!r} committed twice with different "
+                        f"values ({expected[var]!r} then {val!r})"
+                    )
+                expected.setdefault(var, val)
+            elif e.kind == "read_ok" and e.variable in expected:
+                if e.value != expected[e.variable]:
+                    out.append(
+                        f"write-once {e.variable!r} read back as "
+                        f"{e.value!r}, expected {expected[e.variable]!r}"
+                    )
+            elif (
+                e.kind == "persist"
+                and e.fields.get("honest")
+                and e.fields.get("completed")
+                and e.t == MAX_UINT64
+                and e.variable in expected
+                and e.value != expected[e.variable]
+            ):
+                out.append(
+                    f"honest replica {e.node} persisted conflicting "
+                    f"write-once value for {e.variable!r}"
+                )
+        return out
+
+    # -- 2. timestamp monotonicity at honest replicas --------------------
+
+    def _check_monotonic(self) -> list[str]:
+        out = []
+        latest: dict[tuple[str, bytes], int] = {}
+        for e in self.recorder.events("persist"):
+            if not e.fields.get("honest") or not e.fields.get("completed"):
+                continue
+            key = (e.node, e.variable)
+            prev = latest.get(key)
+            if prev is not None and e.t < prev:
+                out.append(
+                    f"honest replica {e.node} went back in time on "
+                    f"{e.variable!r}: t={prev} then t={e.t}"
+                )
+            latest[key] = max(prev or 0, e.t)
+        return out
+
+    # -- 3. read integrity ------------------------------------------------
+
+    def _check_read_integrity(self, servers) -> list[str]:
+        out = []
+        seen: set[tuple[bytes, bytes]] = set()
+        for e in self.recorder.events("read_ok"):
+            if not e.value:  # empty read: nothing claimed, nothing to back
+                continue
+            key = (e.variable, e.value)
+            if key in seen:
+                continue
+            seen.add(key)
+            if not self._value_is_backed(servers, e.variable, e.value):
+                out.append(
+                    f"read of {e.variable!r} returned {e.value!r} with no "
+                    f"verifiable collective signature at any honest replica"
+                )
+        return out
+
+    def _value_is_backed(self, servers, variable: bytes, value: bytes) -> bool:
+        for srv in servers:
+            try:
+                versions = srv.storage.versions(variable)
+            except Exception:
+                continue
+            for t in sorted(versions, reverse=True):
+                try:
+                    raw = srv.storage.read(variable, t)
+                    p = pkt.parse(raw)
+                except Exception:
+                    continue
+                if (
+                    p.value != value
+                    or p.ss is None
+                    or not p.ss.completed
+                ):
+                    continue
+                try:
+                    srv.crypt.collective.verify(
+                        pkt.tbss(raw),
+                        p.ss,
+                        srv.qs.choose_quorum(qm.AUTH),
+                        srv.crypt.keyring,
+                    )
+                    return True
+                except Exception:
+                    continue
+        return False
+
+    # -- 4. no two conflicting values both gather 2f+1 acks ---------------
+
+    def _check_conflicting_commits(self) -> list[str]:
+        out = []
+        acks: dict[tuple[bytes, int], dict[bytes, set[str]]] = {}
+        for e in self.recorder.events("persist"):
+            if not e.fields.get("completed") or e.value is None:
+                continue
+            acks.setdefault((e.variable, e.t), {}).setdefault(
+                e.value, set()
+            ).add(e.node)
+        need = 2 * self.f + 1
+        for (var, t), by_value in acks.items():
+            committed = [
+                v for v, nodes in by_value.items() if len(nodes) >= need
+            ]
+            if len(committed) > 1:
+                out.append(
+                    f"conflicting commits at ({var!r}, t={t}): "
+                    f"{len(committed)} values each gathered {need}+ acks"
+                )
+        return out

@@ -1,0 +1,131 @@
+"""In-package loopback chaos cluster.
+
+``tests/cluster_utils.py`` builds in-process clusters for the test
+suite; the nemesis CLI (``python -m bftkv_tpu.faults.nemesis``) needs
+the same capability *inside* the package — plus two chaos-specific
+powers the test fixture doesn't have:
+
+- every replica's storage is wrapped in a
+  :class:`~bftkv_tpu.faults.checker.RecordingStorage` feeding one
+  shared :class:`~bftkv_tpu.faults.checker.HistoryRecorder`;
+- :meth:`ChaosCluster.crash` / :meth:`ChaosCluster.restart` model a
+  real crash-restart: the old ``Server`` object is abandoned, a fresh
+  one is built from the same identity **onto the same storage** (the
+  in-process analog of restarting a daemon on its data dir), so
+  anti-entropy has to converge the rejoined replica.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from bftkv_tpu import topology
+from bftkv_tpu.faults.checker import HistoryRecorder, RecordingStorage
+from bftkv_tpu.protocol.client import Client
+from bftkv_tpu.protocol.server import Server
+from bftkv_tpu.storage.memkv import MemStorage
+from bftkv_tpu.transport.loopback import LoopbackNet, TrLoopback
+
+__all__ = ["ChaosCluster", "build_cluster"]
+
+
+@dataclass
+class ChaosCluster:
+    universe: topology.Universe
+    net: LoopbackNet
+    recorder: HistoryRecorder
+    servers: list[Server] = field(default_factory=list)  # quorum (a*)
+    storage_servers: list[Server] = field(default_factory=list)  # rw*
+    clients: list[Client] = field(default_factory=list)
+    _by_name: dict[str, Server] = field(default_factory=dict)
+    _idents: dict[str, object] = field(default_factory=dict)
+
+    @property
+    def all_servers(self) -> list[Server]:
+        return self.servers + self.storage_servers
+
+    @property
+    def f(self) -> int:
+        """Fault bound of the replica group chaos targets (the storage
+        replicas when present, else the quorum servers)."""
+        n = len(self.storage_servers) or len(self.servers)
+        return (n - 1) // 3
+
+    def server_named(self, name: str) -> Server:
+        return self._by_name[name]
+
+    def names(self, storage_only: bool = True) -> list[str]:
+        idents = (
+            self.universe.storage_nodes
+            if storage_only and self.universe.storage_nodes
+            else self.universe.servers
+        )
+        return [i.name for i in idents]
+
+    # -- crash / restart ---------------------------------------------------
+
+    def crash(self, name: str) -> None:
+        """Take the replica dark: transport unregistered, peers see
+        unreachable.  State (the recording storage) survives."""
+        self._by_name[name].tr.stop()
+
+    def restart(self, name: str) -> Server:
+        """Fresh Server from the same identity onto the same storage —
+        the crash-restart the anti-entropy plane must repair."""
+        old = self._by_name[name]
+        old.tr.stop()  # idempotent when already crashed
+        ident = self._idents[name]
+        graph, crypt, qs = topology.make_node(
+            ident, self.universe.view_of(ident)
+        )
+        srv = type(old)(
+            graph, qs, TrLoopback(crypt, self.net), crypt, old.storage
+        )
+        srv.start()
+        self._by_name[name] = srv
+        for pool in (self.servers, self.storage_servers):
+            for i, s in enumerate(pool):
+                if s is old:
+                    pool[i] = srv
+        return srv
+
+    def stop(self) -> None:
+        for s in self.all_servers:
+            s.tr.stop()
+
+
+def build_cluster(
+    n_servers: int = 4,
+    n_users: int = 1,
+    n_rw: int = 4,
+    *,
+    bits: int = 1024,
+    recorder: HistoryRecorder | None = None,
+    server_cls=Server,
+    storage_factory=MemStorage,
+) -> ChaosCluster:
+    uni = topology.build_universe(
+        n_servers, n_users, n_rw, scheme="loop", bits=bits
+    )
+    net = LoopbackNet()
+    recorder = recorder or HistoryRecorder()
+    cluster = ChaosCluster(universe=uni, net=net, recorder=recorder)
+    for ident in uni.servers + uni.storage_nodes:
+        graph, crypt, qs = topology.make_node(ident, uni.view_of(ident))
+        storage = RecordingStorage(
+            storage_factory(), ident.name, recorder
+        )
+        srv = server_cls(graph, qs, TrLoopback(crypt, net), crypt, storage)
+        srv.start()
+        cluster._by_name[ident.name] = srv
+        cluster._idents[ident.name] = ident
+        if ident in uni.servers:
+            cluster.servers.append(srv)
+        else:
+            cluster.storage_servers.append(srv)
+    for i, ident in enumerate(uni.users):
+        graph, crypt, qs = topology.make_node(ident, uni.view_of(ident))
+        tr = TrLoopback(crypt, net)
+        tr.link_id = ident.name  # clients are partitionable links too
+        cluster.clients.append(Client(graph, qs, tr, crypt))
+    return cluster

@@ -1,0 +1,330 @@
+"""Chaos nemesis: seeded, timed fault schedules against a live cluster.
+
+A :class:`Nemesis` turns one integer seed into a deterministic plan of
+chaos steps and executes them against a
+:class:`~bftkv_tpu.faults.harness.ChaosCluster` while client traffic
+runs, then repairs the world (heal partitions, restart crashed
+replicas, drive anti-entropy to convergence) and hands the recorded
+history to the :class:`~bftkv_tpu.faults.checker.SafetyChecker`.
+
+Step kinds:
+
+- ``partition`` — a link-matrix cut isolating one replica from
+  everyone (drop rules on ``transport.send``, both directions), healed
+  at the end of the step;
+- ``crash_restart`` — the replica goes dark mid-traffic and is
+  restarted as a *fresh* ``Server`` on the same storage; anti-entropy
+  must converge it back;
+- ``clock_skew`` — the replica's ``time`` answers are shifted by a
+  seeded delta (the timestamp path under desynchronized clocks);
+- ``link_delay`` — seeded delays on one replica's inbound links (the
+  partial-synchrony regime where threshold systems pay their latency
+  price);
+- ``stale_replay`` / ``collude`` — Byzantine modes as failpoint
+  programs (:mod:`bftkv_tpu.faults.byzantine`): genuinely-signed stale
+  answers, or the full sign-anything/store-anything colluder.
+
+Every step touches at most one replica at a time, keeping the
+adversary inside the ``f`` budget a ``3f+1`` cluster promises to
+tolerate — so ZERO safety violations is the pass bar, not a wish.
+
+One seeded round from the shell::
+
+    python -m bftkv_tpu.faults.nemesis --seed 7
+
+exits non-zero if the checker reports any violation, and prints the
+plan + fault-trace summary as JSON (``--json``) for the CI soak lane.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+
+from bftkv_tpu.faults import byzantine, failpoint as fp
+from bftkv_tpu.faults.checker import SafetyChecker
+from bftkv_tpu.faults.harness import ChaosCluster, build_cluster
+
+__all__ = ["Nemesis", "main"]
+
+STEP_KINDS = (
+    "partition",
+    "crash_restart",
+    "clock_skew",
+    "link_delay",
+    "stale_replay",
+    "collude",
+)
+
+
+class Nemesis:
+    def __init__(
+        self,
+        cluster: ChaosCluster,
+        seed: int = 0,
+        registry: fp.FaultRegistry | None = None,
+    ):
+        self.cluster = cluster
+        self.seed = seed
+        self.registry = registry or fp.registry
+        self._written: dict[bytes, bytes] = {}
+        self.failures = {"write": 0, "read": 0}
+
+    # -- deterministic planning -------------------------------------------
+
+    def plan(self, steps: int = 4) -> list[dict]:
+        """Pure function of (seed, cluster shape): the schedule replays
+        identically run to run."""
+        rng = random.Random(self.seed)
+        targets = sorted(self.cluster.names(storage_only=True))
+        out = []
+        for i in range(steps):
+            kind = STEP_KINDS[rng.randrange(len(STEP_KINDS))]
+            step = {"step": i, "kind": kind, "target": targets[rng.randrange(len(targets))]}
+            if kind == "clock_skew":
+                step["delta"] = rng.choice([-1000, 1000, 1 << 20])
+            elif kind == "link_delay":
+                step["seconds"] = round(0.01 + 0.04 * rng.random(), 4)
+            out.append(step)
+        return out
+
+    # -- primitives --------------------------------------------------------
+
+    def partition(self, isolated: str, rule_id: str = "") -> list[fp.Rule]:
+        """Cut every link to/from ``isolated`` (peers AND clients)."""
+        name = isolated
+
+        def cut(ctx: dict) -> bool:
+            return ctx.get("src") == name or ctx.get("dst") == name
+
+        return [
+            self.registry.add(
+                "transport.send",
+                "drop",
+                match=cut,
+                rule_id=rule_id or f"partition:{name}",
+            )
+        ]
+
+    def link_delay(
+        self, target: str, seconds: float, rule_id: str = ""
+    ) -> list[fp.Rule]:
+        return [
+            self.registry.add(
+                "transport.send",
+                "delay",
+                match={"dst": target},
+                seconds=seconds,
+                max_seconds=seconds * 3,
+                rule_id=rule_id or f"delay:{target}",
+            )
+        ]
+
+    def clock_skew(
+        self, target: str, delta: int, rule_id: str = ""
+    ) -> list[fp.Rule]:
+        return [
+            self.registry.add(
+                "server.time",
+                "skew",
+                match={"node": target},
+                delta=delta,
+                rule_id=rule_id or f"skew:{target}",
+            )
+        ]
+
+    def heal(self, rules: list[fp.Rule]) -> None:
+        self.registry.remove_all(rules)
+
+    # -- traffic -----------------------------------------------------------
+
+    def _client(self, i: int):
+        clients = self.cluster.clients
+        return clients[i % len(clients)]
+
+    def traffic(self, tag: str, writes: int = 3, reads: int = 3) -> None:
+        """A burst of recorded writes + reads.  Failures are counted,
+        not raised: under a partition failing is correct behavior."""
+        rec = self.cluster.recorder
+        cl = self._client(0)
+        cname = "u01"
+        for i in range(writes):
+            var = f"chaos/{tag}/{i}".encode()
+            val = f"value-{tag}-{i}".encode()
+            try:
+                cl.write(var, val)
+                rec.write_ok(cname, var, val)
+                self._written[var] = val
+            except Exception as e:
+                rec.write_fail(cname, var, e)
+                self.failures["write"] += 1
+        # str seeds hash via sha512 (deterministic); a tuple seed would
+        # go through PYTHONHASHSEED-salted hash() and break replay.
+        rng = random.Random(f"{self.seed}|{tag}")
+        candidates = sorted(self._written)
+        for _ in range(min(reads, len(candidates))):
+            var = candidates[rng.randrange(len(candidates))]
+            try:
+                rec.read_ok(cname, var, cl.read(var))
+            except Exception as e:
+                rec.read_fail(cname, var, e)
+                self.failures["read"] += 1
+
+    # -- convergence -------------------------------------------------------
+
+    def converge(self, max_rounds: int = 6) -> bool:
+        """Drive anti-entropy rounds until every storage replica's
+        digest root agrees (bounded).  Returns True on convergence."""
+        from bftkv_tpu.sync import SyncDaemon
+
+        replicas = self.cluster.storage_servers or self.cluster.servers
+        daemons = [
+            SyncDaemon(s, interval=999, rng=random.Random(self.seed + i))
+            for i, s in enumerate(replicas)
+        ]
+        for _ in range(max_rounds):
+            roots = {s._sync_tree().root() for s in replicas}
+            if len(roots) == 1:
+                return True
+            for d in daemons:
+                try:
+                    d.run_round()
+                except Exception:
+                    pass
+        return len({s._sync_tree().root() for s in replicas}) == 1
+
+    # -- one full run ------------------------------------------------------
+
+    def run_step(self, step: dict, dwell: float = 0.0) -> None:
+        kind, target = step["kind"], step["target"]
+        tag = f"s{step['step']}-{kind}"
+        if kind == "partition":
+            rules = self.partition(target)
+            try:
+                self.traffic(tag)
+                if dwell:
+                    time.sleep(dwell)
+            finally:
+                self.heal(rules)
+        elif kind == "crash_restart":
+            self.cluster.crash(target)
+            try:
+                self.traffic(tag)
+                if dwell:
+                    time.sleep(dwell)
+            finally:
+                self.cluster.restart(target)
+        elif kind == "clock_skew":
+            rules = self.clock_skew(target, step["delta"])
+            try:
+                self.traffic(tag)
+            finally:
+                self.heal(rules)
+        elif kind == "link_delay":
+            rules = self.link_delay(target, step["seconds"])
+            try:
+                self.traffic(tag)
+            finally:
+                self.heal(rules)
+        elif kind == "stale_replay":
+            rules = byzantine.make_stale_replayer(self.registry, target)
+            try:
+                self.traffic(tag)
+            finally:
+                self.registry.remove_all(rules)
+        elif kind == "collude":
+            rules = byzantine.make_colluder(self.registry, target)
+            try:
+                self.traffic(tag)
+            finally:
+                self.registry.remove_all(rules)
+        else:  # pragma: no cover
+            raise ValueError(f"unknown step kind {kind!r}")
+
+    def run(self, steps: int = 4, dwell: float = 0.0) -> dict:
+        """Arm, execute the seeded plan with traffic, repair, check.
+        Returns a report dict (``violations`` empty = safe run)."""
+        plan = self.plan(steps)
+        self.registry.arm(self.seed)
+        try:
+            cl = self._client(0)
+            once_var, once_val = b"chaos/once", b"immutable"
+            cl.write_once(once_var, once_val)
+            self.cluster.recorder.write_once_ok("u01", once_var, once_val)
+            self.traffic("baseline")
+            for step in plan:
+                self.run_step(step, dwell=dwell)
+            self.traffic("final")
+            try:
+                self.cluster.recorder.read_ok(
+                    "u01", once_var, cl.read(once_var)
+                )
+            except Exception as e:
+                self.cluster.recorder.read_fail("u01", once_var, e)
+            converged = self.converge()
+            trace = self.registry.trace()
+        finally:
+            self.registry.disarm()
+        checker = SafetyChecker(self.cluster.recorder, f=self.cluster.f)
+        replicas = self.cluster.storage_servers or self.cluster.servers
+        violations = checker.check(replicas)
+        return {
+            "seed": self.seed,
+            "plan": plan,
+            "converged": converged,
+            "faults_fired": len(trace),
+            "fault_trace": [list(e) for e in trace[:200]],
+            "failures": dict(self.failures),
+            "violations": violations,
+        }
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        description="seeded chaos round against an in-process cluster"
+    )
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--steps", type=int, default=4)
+    ap.add_argument("--servers", type=int, default=4)
+    ap.add_argument("--rw", type=int, default=4)
+    ap.add_argument("--bits", type=int, default=1024)
+    ap.add_argument("--dwell", type=float, default=0.0,
+                    help="extra seconds to hold each fault window open")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full report as JSON")
+    args = ap.parse_args(argv)
+
+    cluster = build_cluster(args.servers, 1, args.rw, bits=args.bits)
+    try:
+        report = Nemesis(cluster, seed=args.seed).run(
+            steps=args.steps, dwell=args.dwell
+        )
+    finally:
+        cluster.stop()
+    if args.json:
+        print(json.dumps(report, indent=2, default=repr))
+        return 1 if report["violations"] or not report["converged"] else 0
+    print(
+        f"nemesis seed={report['seed']} steps={len(report['plan'])} "
+        f"faults_fired={report['faults_fired']} "
+        f"failures={report['failures']} converged={report['converged']}"
+    )
+    for v in report["violations"]:
+        print(f"VIOLATION: {v}")
+    if report["violations"]:
+        print("nemesis: SAFETY VIOLATIONS FOUND")
+        return 1
+    if not report["converged"]:
+        print("nemesis: replicas did not converge")
+        return 1
+    print("nemesis: ok (zero safety violations)")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -42,6 +42,7 @@ import time
 import numpy as np
 
 from bftkv_tpu import trace
+from bftkv_tpu.faults import failpoint as fp
 from bftkv_tpu.metrics import registry as metrics
 
 __all__ = [
@@ -271,6 +272,13 @@ class _BatchDispatcher:
                     work.put(batch)
 
     def _flush(self, batch: list[_Pending]) -> None:
+        if fp.ARMED:
+            # ``dispatch.flush`` failpoint: a stalled device launch —
+            # every caller blocked on this flush waits it out, which is
+            # exactly what a wedged accelerator round trip looks like.
+            act = fp.fire("dispatch.flush", name=self.name)
+            if act is not None and act.kind == "stall":
+                time.sleep(fp.delay_seconds(act))
         flat = [it for p in batch for it in p.items]
         occupancy = len(flat) / self.max_batch
         metrics.observe(f"{self.name}.batch", len(flat))

@@ -41,6 +41,8 @@ from bftkv_tpu import transport as tp
 from bftkv_tpu.crypto import auth as authmod
 from bftkv_tpu.crypto import cert as certmod
 from bftkv_tpu.crypto import signature as sigmod
+from bftkv_tpu.errors import error_from_string
+from bftkv_tpu.faults import failpoint as fp
 from bftkv_tpu.errors import (
     ERR_AUTHENTICATION_FAILURE,
     ERR_BAD_TIMESTAMP,
@@ -149,15 +151,58 @@ class Server(Protocol):
         # Dispatch by name so subclasses (the Byzantine Mal* family,
         # reference: malserver_test.go:23-194) override handlers by
         # plain method definition.
+        run = getattr(self, name)
+        if fp.ARMED:
+            # ``server.admission`` failpoint: error reply, crash, or a
+            # Byzantine handler override (faults/byzantine.py programs).
+            act = fp.fire(
+                "server.admission",
+                node=getattr(self.self_node, "name", ""),
+                cmd=cmd_name,
+            )
+            if act is not None:
+                run = self._admission_fault(act, cmd, run)
         if tctx is not None:
             with trace.attach(trace.SpanContext(*tctx)), trace.span(
                 f"server.{cmd_name}",
                 attrs={"node": getattr(self.self_node, "name", "")},
             ):
-                res = getattr(self, name)(plain, peer, sender)
+                res = run(plain, peer, sender)
         else:
-            res = getattr(self, name)(plain, peer, sender)
+            res = run(plain, peer, sender)
         return self.crypt.message.encrypt([sender], res or b"", nonce)
+
+    def _admission_fault(self, act, cmd: int, run):
+        """Interpret one fired ``server.admission`` action as a handler
+        replacement: ``error`` raises the named interned error,
+        ``delay`` stalls then serves honestly, ``crash`` takes this
+        replica's transport down mid-request, ``handle`` substitutes a
+        Byzantine program ``fn(server, cmd, req, peer, sender)``."""
+        if act.kind == "error":
+            msg = act.params.get("error", "internal error")
+
+            def run_error(req, peer, sender):
+                raise error_from_string(msg)
+
+            return run_error
+        if act.kind == "delay":
+
+            def run_delayed(req, peer, sender):
+                time.sleep(fp.delay_seconds(act))
+                return run(req, peer, sender)
+
+            return run_delayed
+        if act.kind == "crash":
+
+            def run_crash(req, peer, sender):
+                self.tr.stop()  # the node goes dark for everyone
+                raise tp.ERR_UNREACHABLE
+
+            return run_crash
+        if act.kind == "handle":
+            fn = act.params["fn"]
+            return lambda req, peer, sender: fn(self, cmd, req, peer, sender)
+        return run
 
     # -- membership (reference: server.go:64-120) -------------------------
 
@@ -205,6 +250,16 @@ class Server(Protocol):
             t = pkt.parse(raw).t
         except ERR_NOT_FOUND:
             pass
+        if fp.ARMED:
+            # ``server.time`` failpoint: clock skew on the timestamp
+            # path — this replica's answers shift by delta (clamped to
+            # the valid range; MAX_UINT64 stays the write-once marker).
+            act = fp.fire(
+                "server.time", node=getattr(self.self_node, "name", "")
+            )
+            if act is not None and act.kind == "skew":
+                t = min(max(t + int(act.params.get("delta", 0)), 0),
+                        MAX_UINT64 - 1)
         return t.to_bytes(8, "big")
 
     # -- read (reference: server.go:145-187) ------------------------------

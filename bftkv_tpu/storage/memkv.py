@@ -13,6 +13,7 @@ import bisect
 import threading
 
 from bftkv_tpu.errors import ERR_NOT_FOUND
+from bftkv_tpu.faults import failpoint as fp
 
 
 class MemStorage:
@@ -55,6 +56,12 @@ class MemStorage:
             ]
 
     def write(self, variable: bytes, t: int, value: bytes) -> None:
+        if fp.ARMED:
+            # ``storage.write`` failpoint: the in-memory backend can
+            # only fail whole ("torn" is meaningless without files).
+            act = fp.fire("storage.write", backend="mem", op="write")
+            if act is not None and act.kind in ("io_error", "torn"):
+                raise OSError("injected storage I/O error")
         with self._lock:
             entry = self._data.get(variable)
             if entry is None:

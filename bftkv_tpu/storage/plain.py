@@ -2,8 +2,12 @@
 
 File name is ``hex(variable).t`` inside the store directory; the latest
 version is found by scanning for the maximum ``t`` suffix
-(reference: storage/plain/plain.go:28-60). Writes are atomic
-(write-to-temp + rename) and the whole store is guarded by a lock the
+(reference: storage/plain/plain.go:28-60). Writes are **crash-safe**:
+write-to-temp, fsync the file, rename, fsync the directory — a torn
+write can only ever leave a partial ``.tmp`` the read/inventory paths
+ignore, never a half-written version (the reference renames but never
+fsyncs, plain.go:62-75, so a power cut could publish an empty rename or
+lose the directory entry).  The whole store is guarded by a lock the
 same way the reference serializes file I/O with a mutex
 (reference: storage/plain/plain.go:19).
 """
@@ -14,12 +18,25 @@ import os
 import threading
 
 from bftkv_tpu.errors import ERR_NOT_FOUND
+from bftkv_tpu.faults import failpoint as fp
 
 
 class PlainStorage:
-    def __init__(self, path: str):
+    def __init__(self, path: str, *, fsync: bool | None = None):
         self.path = path
         self._lock = threading.Lock()
+        # The write *ordering* (temp + rename) is always on — a crash
+        # can never publish a torn version.  The per-write fsync pair
+        # (file + directory) is a durability policy: ~5 ms/write on
+        # commodity disks, so the library default matches the
+        # reference's leveldb stance (WriteOptions.sync=false) and the
+        # daemon opts IN (cmd/bftkv.py) — power-cut durability is a
+        # deployment property, not a test-harness one.
+        # BFTKV_PLAIN_FSYNC=1/0 overrides either way.
+        if fsync is None:
+            env = os.environ.get("BFTKV_PLAIN_FSYNC", "")
+            fsync = env == "1"
+        self.fsync = fsync
         os.makedirs(path, exist_ok=True)
 
     def _prefix(self, variable: bytes) -> str:
@@ -63,6 +80,24 @@ class PlainStorage:
             except FileNotFoundError:
                 raise ERR_NOT_FOUND from None
 
+    def _write_atomic(self, fn: str, data: bytes) -> None:
+        """temp + fsync(file) + rename + fsync(dir); caller holds the
+        lock.  After a crash at ANY point, readers see either the old
+        state or the complete new file — never a torn version."""
+        tmp = fn + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+            if self.fsync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, fn)
+        if self.fsync:
+            dfd = os.open(self.path, os.O_RDONLY)
+            try:
+                os.fsync(dfd)
+            finally:
+                os.close(dfd)
+
     def write(self, variable: bytes, t: int, value: bytes) -> None:
         with self._lock:
             stem = self._prefix(variable)
@@ -73,15 +108,22 @@ class PlainStorage:
                 # write is atomic like the data files'.
                 kf = os.path.join(self.path, stem + ".k")
                 if not os.path.exists(kf):
-                    tmp = kf + ".tmp"
-                    with open(tmp, "wb") as f:
-                        f.write(variable)
-                    os.replace(tmp, kf)
+                    self._write_atomic(kf, variable)
             fn = os.path.join(self.path, f"{stem}.{t}")
-            tmp = fn + ".tmp"
-            with open(tmp, "wb") as f:
-                f.write(value)
-            os.replace(tmp, fn)
+            if fp.ARMED:
+                # ``storage.write`` failpoint: injected I/O error, or a
+                # torn write — half the bytes land in the .tmp and the
+                # "process" dies before rename (the crash the atomic
+                # protocol exists to survive).
+                act = fp.fire("storage.write", backend="plain", op="write")
+                if act is not None:
+                    if act.kind == "torn":
+                        with open(fn + ".tmp", "wb") as f:
+                            f.write(value[: max(1, len(value) // 2)])
+                        raise OSError("injected torn write")
+                    if act.kind == "io_error":
+                        raise OSError("injected storage I/O error")
+            self._write_atomic(fn, value)
 
     def versions(self, variable: bytes) -> list[int]:
         """All stored timestamps for ``variable`` (ascending)."""

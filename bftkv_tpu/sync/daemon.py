@@ -42,6 +42,7 @@ from bftkv_tpu import packet as pkt
 from bftkv_tpu import quorum as qm
 from bftkv_tpu import trace
 from bftkv_tpu import transport as tp
+from bftkv_tpu.faults import failpoint as fp
 from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.sync.digest import HIDDEN_PREFIX, latest_completed
 
@@ -228,6 +229,17 @@ class SyncDaemon:
         round reaches every record some honest divergent peer serves;
         safety never depends on the count — admission re-verifies
         everything.  Returns aggregate counters."""
+        if fp.ARMED:
+            # ``sync.round`` failpoint: the round aborts before any
+            # digest poll — a daemon wedged or killed mid-schedule.
+            act = fp.fire(
+                "sync.round",
+                node=getattr(self.server.self_node, "name", ""),
+            )
+            if act is not None and act.kind == "abort":
+                metrics.incr("sync.aborted")
+                return {"peers": 0, "pulled_peers": 0, "admitted": 0,
+                        "rejected": 0, "stale": 0, "aborted": 1}
         with trace.span("sync.round") as sp:
             stats = self._run_round_inner()
             sp.attrs.update(stats)

@@ -23,14 +23,18 @@ the crypto layer.
 
 from __future__ import annotations
 
+import os
 import queue
+import random
 import threading
+import time
 from dataclasses import dataclass
 from typing import Callable, Protocol
 
 from bftkv_tpu import packet as pkt
 from bftkv_tpu import trace
 from bftkv_tpu.errors import ERR_UNKNOWN_SESSION, new_error
+from bftkv_tpu.faults import failpoint as fp
 from bftkv_tpu.metrics import registry as metrics
 
 __all__ = [
@@ -61,6 +65,10 @@ __all__ = [
     "multicast",
     "record_rpc",
     "instrument_handler",
+    "RetryPolicy",
+    "PeerHealth",
+    "peer_health",
+    "default_retry_policy",
 ]
 
 # Command enum (reference: transport.go:14-28).
@@ -161,6 +169,136 @@ ERR_TRANSPORT_SECURITY = new_error("transport: transport security error")
 ERR_NONCE_MISMATCH = new_error("transport: nonce mismatch")
 ERR_SERVER_ERROR = new_error("transport: server error")
 ERR_NO_ADDRESS = new_error("transport: no address")
+# Hardened-client vocabulary.  ERR_UNREACHABLE interns the same message
+# as the loopback transport's (interning makes them the identical
+# class); ERR_RPC_TIMEOUT is a per-RPC deadline expiry; ERR_PEER_OPEN
+# is a post skipped because the peer's circuit breaker is open.
+ERR_UNREACHABLE = new_error("transport: peer unreachable")
+ERR_RPC_TIMEOUT = new_error("transport: rpc timeout")
+ERR_PEER_OPEN = new_error("transport: peer circuit open")
+
+#: Errors the retry policy may retry and the health tracker counts:
+#: transport-level failures only — interned protocol errors (bad
+#: timestamp, equivocation, ...) are *answers*, not outages.
+_TRANSIENT = {
+    ERR_SERVER_ERROR.message,
+    ERR_UNREACHABLE.message,
+    ERR_RPC_TIMEOUT.message,
+}
+
+
+class RetryPolicy:
+    """Bounded jittered-backoff retries for one logical post.
+
+    ``retries`` is the number of *re*-attempts after the first try (0 =
+    off, the default — retry changes delivery to at-least-once, which
+    is safe for this protocol's idempotent commands but is the
+    operator's call).  Backoff doubles per attempt up to ``max_backoff``
+    with ±50% jitter so synchronized clients do not re-stampede a
+    recovering peer."""
+
+    __slots__ = ("retries", "backoff", "max_backoff")
+
+    def __init__(
+        self,
+        retries: int = 0,
+        backoff: float = 0.05,
+        max_backoff: float = 1.0,
+    ):
+        self.retries = retries
+        self.backoff = backoff
+        self.max_backoff = max_backoff
+
+    def delay(self, attempt: int) -> float:
+        base = min(self.backoff * (2 ** (attempt - 1)), self.max_backoff)
+        return base * (0.5 + random.random())
+
+
+#: Process default; a transport instance overrides with its own
+#: ``retry_policy`` attribute.
+default_retry_policy = RetryPolicy(
+    retries=int(os.environ.get("BFTKV_RPC_RETRIES", "0") or 0),
+    backoff=float(os.environ.get("BFTKV_RPC_BACKOFF", "0.05") or 0.05),
+)
+
+
+class PeerHealth:
+    """Per-peer consecutive-failure tracking with a circuit breaker.
+
+    After ``threshold`` consecutive transient failures a peer's circuit
+    opens: posts to it are skipped instantly (``ERR_PEER_OPEN``)
+    instead of each fan-out eating the full RPC timeout every round.
+    After ``open_secs`` one probe is let through (half-open); success
+    closes the circuit, failure re-opens it.  Disabled by default
+    (``BFTKV_PEER_CB=1`` enables) — skipping a peer trades a little
+    completeness for tail latency, which is an operator decision."""
+
+    def __init__(
+        self,
+        threshold: int = 3,
+        open_secs: float = 5.0,
+        enabled: bool = False,
+    ):
+        self.threshold = threshold
+        self.open_secs = open_secs
+        self.enabled = enabled
+        self._lock = threading.Lock()
+        # addr -> [consecutive_fails, open_until_monotonic]
+        self._states: dict[str, list] = {}
+
+    def allow(self, addr: str) -> bool:
+        if not self.enabled:
+            return True
+        with self._lock:
+            st = self._states.get(addr)
+            if st is None or st[0] < self.threshold:
+                return True
+            now = time.monotonic()
+            if now >= st[1]:
+                # Half-open: this caller probes; concurrent callers keep
+                # skipping until the probe resolves.
+                st[1] = now + self.open_secs
+                return True
+            return False
+
+    def ok(self, addr: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            st = self._states.pop(addr, None)
+        if st is not None and st[0] >= self.threshold:
+            metrics.incr("transport.peer.recovered")
+
+    def fail(self, addr: str) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            st = self._states.setdefault(addr, [0, 0.0])
+            st[0] += 1
+            st[1] = time.monotonic() + self.open_secs
+            opened = st[0] == self.threshold  # the open *transition*
+        if opened:
+            metrics.incr("transport.peer.opens")
+
+    def open_peers(self) -> list[str]:
+        with self._lock:
+            now = time.monotonic()
+            return [
+                a
+                for a, st in self._states.items()
+                if st[0] >= self.threshold and now < st[1]
+            ]
+
+    def reset(self) -> None:
+        with self._lock:
+            self._states.clear()
+
+
+peer_health = PeerHealth(
+    threshold=int(os.environ.get("BFTKV_PEER_CB_THRESHOLD", "3") or 3),
+    open_secs=float(os.environ.get("BFTKV_PEER_CB_OPEN_SECS", "5") or 5),
+    enabled=os.environ.get("BFTKV_PEER_CB", "") == "1",
+)
 
 
 @dataclass
@@ -330,12 +468,88 @@ def multicast(
             break  # early exit; remaining posts finish in their threads
 
 
+def _inject_send_fault(tr, url, data, name, addr):
+    """``transport.send`` failpoint: per-link drop / delay / duplicate /
+    corrupt.  Returns the (possibly corrupted) payload to post, or
+    raises the injected transport error."""
+    act = fp.fire(
+        "transport.send",
+        src=fp.link_of(getattr(tr, "link_id", "") or ""),
+        dst=fp.link_of(addr),
+        cmd=name,
+    )
+    if act is None:
+        return data
+    if act.kind == "drop":
+        raise ERR_UNREACHABLE
+    if act.kind == "delay":
+        secs = fp.delay_seconds(act)
+        deadline = getattr(tr, "rpc_timeout", None)
+        if deadline is not None and secs >= deadline:
+            # The peer "answers" after the deadline: the caller sees a
+            # timeout, never the late bytes (loopback's analog of the
+            # HTTP socket timeout).
+            time.sleep(deadline)
+            raise ERR_RPC_TIMEOUT
+        with trace.span("fault.delay", attrs={"seconds": round(secs, 4)}):
+            time.sleep(secs)
+        return data
+    if act.kind == "corrupt":
+        return fp.corrupt_bytes(data, act.params["u"])
+    if act.kind == "dup":
+        # Deliver twice; the response to the duplicate is discarded.
+        try:
+            tr.post(url, data)
+        except Exception:
+            pass
+        return data
+    return data
+
+
+def _send(tr, url, cipher, name, addr) -> bytes:
+    """One logical post: fault injection, circuit-breaker accounting,
+    and bounded jittered-backoff retries on *transient* transport
+    errors (server error / unreachable / rpc timeout — never interned
+    protocol errors, which are answers)."""
+    policy = getattr(tr, "retry_policy", None) or default_retry_policy
+    attempt = 0
+    while True:
+        try:
+            data = cipher
+            if fp.ARMED:
+                data = _inject_send_fault(tr, url, data, name, addr)
+            res = tr.post(url, data)
+            peer_health.ok(addr)
+            return res
+        except Exception as e:
+            transient = getattr(e, "message", None) in _TRANSIENT
+            attempt += 1
+            if not transient or attempt > policy.retries:
+                if transient:
+                    peer_health.fail(addr)
+                else:
+                    # A non-transient error is an ANSWER (tunneled
+                    # x-error / loopback raise): the peer is reachable,
+                    # so it must close a half-open circuit — otherwise
+                    # a recovered replica whose honest replies are
+                    # protocol errors would stay skipped forever.
+                    peer_health.ok(addr)
+                raise
+            metrics.incr("transport.retries", labels={"cmd": name})
+            time.sleep(policy.delay(attempt))
+
+
 def _post_one(tr, name, peer, addr, cipher, nonce, payload, ch) -> None:
     """One peer's post → decrypt → nonce check (the body of the fan-out
     worker, split out so the traced and untraced paths share it)."""
     try:
+        url = addr + PREFIX + name
+        if not peer_health.allow(addr):
+            metrics.incr("transport.peer.skipped", labels={"cmd": name})
+            ch.put(MulticastResponse(peer, None, ERR_PEER_OPEN()))
+            return
         try:
-            res = tr.post(addr + PREFIX + name, cipher)
+            res = _send(tr, url, cipher, name, addr)
             plain, _sender, echoed = tr.decrypt(res)
         except ERR_UNKNOWN_SESSION:
             # The peer does not hold the session this envelope
@@ -352,7 +566,7 @@ def _post_one(tr, name, peer, addr, cipher, nonce, payload, ch) -> None:
             cipher2 = sec.message.encrypt(
                 [peer], payload, nonce2, force_bootstrap=True
             )
-            res = tr.post(addr + PREFIX + name, cipher2)
+            res = _send(tr, url, cipher2, name, addr)
             plain, _sender, echoed = tr.decrypt(res)
             if echoed != nonce2:
                 ch.put(MulticastResponse(peer, None, ERR_NONCE_MISMATCH()))

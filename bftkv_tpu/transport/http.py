@@ -12,6 +12,7 @@ concurrency model (many servers run in one test process).
 
 from __future__ import annotations
 
+import socket
 import threading
 import urllib.error
 import urllib.request
@@ -20,16 +21,33 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from bftkv_tpu import transport as tp
 from bftkv_tpu.errors import Error, error_from_string
 
-__all__ = ["TrHTTP", "MalTrHTTP"]
+__all__ = ["TrHTTP", "MalTrHTTP", "default_rpc_timeout"]
 
 import os
 
 CONNECT_TIMEOUT = 5.0
-# The reference pins 10 s (http.go:39-50); overridable because a
+# The reference pins 10 s (http.go:39-50); configurable because a
 # many-server in-process cluster on a shared CPU box can push honest
-# handlers past it (tests; CI).
-RESPONSE_TIMEOUT = float(os.environ.get("BFTKV_HTTP_TIMEOUT", "10"))
+# handlers past it (tests; CI), and chaos-delay runs need it *short*.
+# BFTKV_RPC_TIMEOUT is the canonical knob (--rpc-timeout plumbs it);
+# BFTKV_HTTP_TIMEOUT stays honored for compatibility.
+RESPONSE_TIMEOUT = float(
+    os.environ.get("BFTKV_RPC_TIMEOUT")
+    or os.environ.get("BFTKV_HTTP_TIMEOUT")
+    or "10"
+)
 NONCE_SIZE = 8
+
+
+def default_rpc_timeout() -> float:
+    return RESPONSE_TIMEOUT
+
+
+def _is_timeout(e: Exception) -> bool:
+    if isinstance(e, (TimeoutError, socket.timeout)):
+        return True
+    reason = getattr(e, "reason", None)
+    return isinstance(reason, (TimeoutError, socket.timeout))
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -78,8 +96,14 @@ class _Handler(BaseHTTPRequestHandler):
 class TrHTTP:
     """(reference: http.go:21-95)."""
 
-    def __init__(self, security):
+    def __init__(self, security, *, rpc_timeout: float | None = None):
         self.security = security
+        #: Per-RPC response deadline; the transport-agnostic fault and
+        #: retry layer (transport._send) reads the same attribute.
+        self.rpc_timeout = (
+            rpc_timeout if rpc_timeout is not None else RESPONSE_TIMEOUT
+        )
+        self.link_id = ""  # set on start(); clients keep ""
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -93,7 +117,7 @@ class TrHTTP:
         )
         cmd_name = addr.rsplit("/", 1)[-1]
         try:
-            with urllib.request.urlopen(req, timeout=RESPONSE_TIMEOUT) as res:
+            with urllib.request.urlopen(req, timeout=self.rpc_timeout) as res:
                 body = res.read()
             tp.record_rpc("http", "client", cmd_name, len(body), len(msg or b""))
             return body
@@ -105,7 +129,9 @@ class TrHTTP:
             raise tp.ERR_SERVER_ERROR from None
         except Error:
             raise
-        except Exception:
+        except Exception as e:
+            if _is_timeout(e):
+                raise tp.ERR_RPC_TIMEOUT from None
             raise tp.ERR_SERVER_ERROR from None
 
     def multicast(self, cmd: int, peers: list, data: bytes | None, cb) -> None:
@@ -119,6 +145,7 @@ class TrHTTP:
         """``addr`` is ``host:port`` (the listen side of the node's
         certificate address)."""
         host, _, port = addr.rpartition(":")
+        self.link_id = addr  # this node's side of every link
         self._server = ThreadingHTTPServer(
             (host or "127.0.0.1", int(port)), _Handler
         )

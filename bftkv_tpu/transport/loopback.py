@@ -35,10 +35,21 @@ class LoopbackNet:
 class TrLoopback:
     """Same interface as TrHTTP over a shared :class:`LoopbackNet`."""
 
-    def __init__(self, security, net: LoopbackNet):
+    def __init__(
+        self, security, net: LoopbackNet, *, rpc_timeout: float | None = None
+    ):
         self.security = security
         self.net = net
         self._addr: str | None = None
+        #: Per-RPC deadline honored by the transport-agnostic delay
+        #: failpoint (a chaos delay past it becomes a timeout, exactly
+        #: like the HTTP socket deadline).  Default mirrors TrHTTP's.
+        if rpc_timeout is None:
+            from bftkv_tpu.transport.http import default_rpc_timeout
+
+            rpc_timeout = default_rpc_timeout()
+        self.rpc_timeout = rpc_timeout
+        self.link_id = ""  # servers get theirs on start(); see harness
 
     # -- client side ------------------------------------------------------
     def post(self, addr: str, msg: bytes) -> bytes:
@@ -64,6 +75,7 @@ class TrLoopback:
     # -- server side ------------------------------------------------------
     def start(self, o, addr: str) -> None:
         self._addr = addr
+        self.link_id = addr  # this node's side of every link
         # Same transport.* accounting as TrHTTP._dispatch, so
         # single-process cluster tests see the byte/RPC series a
         # deployed fleet exports.

@@ -1,7 +1,12 @@
 """Byzantine fault-injection harness: malicious server / storage /
 client, by subclassing — never mocking — exactly as the reference does
 (reference: protocol/malserver_test.go:23-194, malstorage_test.go:19-115,
-malclient_test.go:83-189)."""
+malclient_test.go:83-189).
+
+The *behaviors* now live in :mod:`bftkv_tpu.faults.byzantine` as
+failpoint handler programs, shared with the chaos nemesis; this module
+keeps the reference-shaped subclass API as a shim over them so the
+existing Byzantine suite and chaos runs exercise one mechanism."""
 
 from __future__ import annotations
 
@@ -9,6 +14,7 @@ from bftkv_tpu import packet as pkt
 from bftkv_tpu import quorum as qm
 from bftkv_tpu import transport as tp
 from bftkv_tpu.errors import ERR_INSUFFICIENT_NUMBER_OF_QUORUM
+from bftkv_tpu.faults import byzantine as byz
 from bftkv_tpu.protocol import majority_error
 from bftkv_tpu.protocol.client import Client
 from bftkv_tpu.protocol.server import Server
@@ -41,25 +47,21 @@ class MalServer(Server):
     def _is_mal(self) -> bool:
         return self.self_node.address in self.mal_addresses
 
+    # Behaviors delegate to the shared failpoint programs
+    # (bftkv_tpu/faults/byzantine.py) — one implementation serves both
+    # this subclass harness and the chaos nemesis.
+
     def _sign(self, req: bytes, peer, sender):
         if not self._is_mal:
             return super()._sign(req, peer, sender)
         # sign whatever arrives (reference: malSign, :64-89)
-        pkt.parse(req)
-        tbss = pkt.tbss(req)
-        share = self.crypt.collective.sign(self.crypt.signer, tbss)
-        return pkt.serialize_signature(share)
+        return byz.sign_anything(self, tp.SIGN, req, peer, sender)
 
     def _write(self, req: bytes, peer, sender):
         if not self._is_mal:
             return super()._write(req, peer, sender)
         # store without any verification (reference: malWrite, :91-112)
-        p = pkt.parse(req)
-        if isinstance(self.storage, MalStorage):
-            self.storage.mal_write(p.variable or b"", p.t, req)
-        else:
-            self.storage.write(p.variable or b"", p.t, req)
-        return None
+        return byz.store_unverified(self, tp.WRITE, req, peer, sender)
 
     # The batch pipeline must face the same adversary: a colluder signs
     # and stores every item of a batch without any verification.
@@ -67,25 +69,14 @@ class MalServer(Server):
     def _batch_sign(self, req: bytes, peer, sender):
         if not self._is_mal:
             return super()._batch_sign(req, peer, sender)
-        results = []
-        for r in pkt.parse_list(req):
-            pkt.parse(r)
-            share = self.crypt.collective.sign(self.crypt.signer, pkt.tbss(r))
-            results.append((None, pkt.serialize_signature(share)))
-        return pkt.serialize_results(results)
+        return byz.batch_sign_anything(self, tp.BATCH_SIGN, req, peer, sender)
 
     def _batch_write(self, req: bytes, peer, sender):
         if not self._is_mal:
             return super()._batch_write(req, peer, sender)
-        results = []
-        for r in pkt.parse_list(req):
-            p = pkt.parse(r)
-            if isinstance(self.storage, MalStorage):
-                self.storage.mal_write(p.variable or b"", p.t, r)
-            else:
-                self.storage.write(p.variable or b"", p.t, r)
-            results.append((None, b""))
-        return pkt.serialize_results(results)
+        return byz.batch_store_unverified(
+            self, tp.BATCH_WRITE, req, peer, sender
+        )
 
 
 class MalClient(Client):

@@ -1,0 +1,372 @@
+"""Failpoint registry + fault-hardened client path (bftkv_tpu/faults,
+transport retry/deadline/circuit-breaker).
+
+The registry tests are pure units; the injection tests run a real
+4+4 loopback cluster and assert the BFT masking property under each
+fault class: a minority-link fault is absorbed, a majority fault fails
+cleanly, and the hardened client path (retries, per-RPC deadlines,
+peer circuit breaking) keeps honest traffic fast around dead peers."""
+
+from __future__ import annotations
+
+import time
+import types
+
+import pytest
+
+from bftkv_tpu import transport as tp
+from bftkv_tpu.errors import Error
+from bftkv_tpu.faults import byzantine as byz
+from bftkv_tpu.faults import failpoint as fp
+from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu.storage.memkv import MemStorage
+
+from cluster_utils import start_cluster
+
+BITS = 1024  # keygen speed; fault injection is bits-agnostic
+
+
+@pytest.fixture(autouse=True)
+def _disarmed():
+    """Every test starts and ends disarmed — a leaked rule would bleed
+    faults into unrelated tests."""
+    fp.disarm()
+    yield
+    fp.disarm()
+
+
+# -- registry units --------------------------------------------------------
+
+
+def test_disarmed_fire_is_noop():
+    assert fp.ARMED is False
+    assert fp.fire("transport.send", dst="x") is None
+    assert fp.registry.trace() == []
+
+
+def test_same_seed_identical_fault_trace():
+    """The tentpole determinism contract: one seed, one fault trace."""
+
+    def run(seed: int):
+        reg = fp.arm(seed)
+        reg.add("transport.send", "drop", prob=0.4, rule_id="d")
+        reg.add("storage.write", "io_error", prob=0.2, rule_id="io")
+        fired = []
+        for i in range(50):
+            fired.append(fp.fire("transport.send", dst=f"n{i % 4}") is not None)
+            fired.append(fp.fire("storage.write", backend="mem") is not None)
+        return fired, [tuple(e) for e in reg.trace()]
+
+    f1, t1 = run(7)
+    f2, t2 = run(7)
+    f3, t3 = run(8)
+    assert f1 == f2 and t1 == t2
+    assert t1 and t3 != t1  # a different seed is a different schedule
+    assert any(f1) and not all(f1)
+
+
+def test_rule_match_times_and_params():
+    reg = fp.arm(0)
+    rule = reg.add(
+        "transport.send",
+        "delay",
+        match={"dst": "rw01", "cmd": lambda c: c in ("time", "read")},
+        times=2,
+        seconds=0.5,
+        rule_id="m",
+    )
+    assert fp.fire("transport.send", dst="rw02", cmd="time") is None
+    assert fp.fire("transport.send", dst="rw01", cmd="write") is None
+    act = fp.fire("transport.send", dst="rw01", cmd="time")
+    assert act is not None and act.kind == "delay"
+    assert fp.delay_seconds(act) == 0.5
+    assert fp.fire("transport.send", dst="rw01", cmd="read") is not None
+    # times=2 exhausted
+    assert fp.fire("transport.send", dst="rw01", cmd="time") is None
+    assert rule.fires == 2
+    reg.remove(rule)
+    assert fp.fire("transport.send", dst="rw01", cmd="time") is None
+
+
+def test_delay_seconds_draw_is_deterministic_and_bounded():
+    reg = fp.arm(5)
+    reg.add(
+        "dispatch.flush", "stall", seconds=0.01, max_seconds=0.05, rule_id="s"
+    )
+    a1 = fp.fire("dispatch.flush", name="dispatch")
+    d1 = fp.delay_seconds(a1)
+    assert 0.01 <= d1 <= 0.05
+    reg2 = fp.arm(5)
+    reg2.add(
+        "dispatch.flush", "stall", seconds=0.01, max_seconds=0.05, rule_id="s"
+    )
+    assert fp.delay_seconds(fp.fire("dispatch.flush", name="dispatch")) == d1
+
+
+def test_corrupt_bytes_changes_payload_preserves_length():
+    data = bytes(range(64))
+    out = fp.corrupt_bytes(data, 0.37)
+    assert out != data and len(out) == len(data)
+    assert fp.corrupt_bytes(b"", 0.5) == b""
+
+
+def test_link_of_normalization():
+    assert fp.link_of("loop://a01") == "a01"
+    assert fp.link_of("http://127.0.0.1:6001/bftkv/v1/read") == "127.0.0.1:6001"
+    assert fp.link_of("a01") == "a01"
+
+
+def test_memstorage_io_error_failpoint():
+    st = MemStorage()
+    fp.arm(1)
+    fp.registry.add(
+        "storage.write", "io_error", match={"backend": "mem"}, times=1
+    )
+    with pytest.raises(OSError):
+        st.write(b"x", 1, b"v")
+    st.write(b"x", 1, b"v")  # times exhausted: back to normal
+    assert st.read(b"x") == b"v"
+
+
+def test_nemesis_plan_is_pure_function_of_seed():
+    from bftkv_tpu.faults.nemesis import Nemesis
+
+    dummy = types.SimpleNamespace(
+        names=lambda storage_only=True: ["rw01", "rw02", "rw03", "rw04"]
+    )
+    p1 = Nemesis(dummy, seed=7).plan(8)
+    p2 = Nemesis(dummy, seed=7).plan(8)
+    p3 = Nemesis(dummy, seed=9).plan(8)
+    assert p1 == p2
+    assert p3 != p1
+    assert {s["kind"] for s in p1} <= set(
+        ("partition", "crash_restart", "clock_skew", "link_delay",
+         "stale_replay", "collude")
+    )
+
+
+# -- live-cluster injection ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = start_cluster(n_servers=4, n_users=1, n_rw=4, bits=BITS)
+    try:
+        yield c
+    finally:
+        c.stop()
+
+
+def test_corrupt_on_minority_link_is_masked(cluster):
+    """Corrupting every payload to one replica breaks its session
+    decrypt, but the 3-of-4 quorum masks it — the write commits."""
+    cl = cluster.clients[0]
+    fp.arm(21)
+    before = metrics.snapshot()
+    fp.registry.add(
+        "transport.send", "corrupt", match={"dst": "rw01"}, rule_id="c"
+    )
+    cl.write(b"fp_corrupt", b"survives")
+    assert cl.read(b"fp_corrupt") == b"survives"
+    snap = metrics.snapshot()
+    key = "faults.fired{action=corrupt,point=transport.send}"
+    assert snap.get(key, 0) > before.get(key, 0)
+
+
+def test_drop_beyond_f_fails_write_cleanly(cluster):
+    """Dropping the links to TWO of four write replicas (> f = 1) must
+    fail the write with a protocol error, not hang or corrupt."""
+    cl = cluster.clients[0]
+    fp.arm(22)
+    fp.registry.add(
+        "transport.send",
+        "drop",
+        match={"dst": lambda d: d in ("rw03", "rw04"), "cmd": "write"},
+        rule_id="d2",
+    )
+    with pytest.raises(Error):
+        cl.write(b"fp_majority", b"nope")
+    fp.disarm()
+    cl.write(b"fp_majority", b"now ok")
+    assert cl.read(b"fp_majority") == b"now ok"
+
+
+def test_retry_recovers_transient_drop(cluster):
+    """A drop that clears after two attempts is absorbed by the bounded
+    jittered-backoff retry policy; transport.retries counts it."""
+    cl = cluster.clients[0]
+    fp.arm(23)
+    fp.registry.add(
+        "transport.send",
+        "drop",
+        match={"dst": "rw01", "cmd": "time"},
+        times=2,
+        rule_id="r",
+    )
+    before = metrics.snapshot()
+    cl.tr.retry_policy = tp.RetryPolicy(retries=3, backoff=0.01)
+    try:
+        cl.write(b"fp_retry", b"retried")
+    finally:
+        del cl.tr.retry_policy
+    assert cl.read(b"fp_retry") == b"retried"
+    snap = metrics.snapshot()
+    key = "transport.retries{cmd=time}"
+    assert snap.get(key, 0) >= before.get(key, 0) + 2
+
+
+def test_rpc_deadline_bounds_injected_delay(cluster):
+    """A 30 s chaos delay on one link becomes a fast per-RPC timeout
+    under a 0.2 s deadline — the quorum absorbs the timed-out peer and
+    the op completes in bounded time."""
+    cl = cluster.clients[0]
+    fp.arm(24)
+    fp.registry.add(
+        "transport.send", "delay", match={"dst": "rw02"}, seconds=30.0,
+        rule_id="slow",
+    )
+    old = cl.tr.rpc_timeout
+    cl.tr.rpc_timeout = 0.2
+    t0 = time.monotonic()
+    try:
+        cl.write(b"fp_deadline", b"fast")
+        assert cl.read(b"fp_deadline") == b"fast"
+    finally:
+        cl.tr.rpc_timeout = old
+    # 3 phases × ≤(a few) deadline hits on one peer: far below the 30 s
+    # the injected delay would have cost without a deadline.
+    assert time.monotonic() - t0 < 10.0
+
+
+def test_circuit_breaker_skips_dead_peer_and_recovers(cluster):
+    """Consecutive failures open the peer's circuit: posts are skipped
+    instantly instead of eating the deadline every round.  A half-open
+    probe after open_secs closes it again once the peer returns."""
+    cl = cluster.clients[0]
+    victim = cluster.server_named("rw04")
+    health = tp.peer_health
+    old = (health.enabled, health.threshold, health.open_secs)
+    health.enabled, health.threshold, health.open_secs = True, 2, 0.2
+    health.reset()
+    before = metrics.snapshot()
+    victim.tr.stop()  # the peer goes dark
+    try:
+        for i in range(3):
+            cl.write(b"fp_cb_%d" % i, b"v")  # 3-of-4 carries each write
+        assert "loop://rw04" in health.open_peers()
+        snap = metrics.snapshot()
+        skipped = sum(
+            v - before.get(k, 0)
+            for k, v in snap.items()
+            if k.startswith("transport.peer.skipped")
+        )
+        assert skipped > 0
+        assert snap.get("transport.peer.opens", 0) > before.get(
+            "transport.peer.opens", 0
+        )
+
+        victim.start()  # peer returns; wait past open_secs, then probe
+        time.sleep(0.25)
+        cl.write(b"fp_cb_back", b"v")
+        deadline = time.monotonic() + 5
+        while health.open_peers() and time.monotonic() < deadline:
+            cl.write(b"fp_cb_back", b"v")
+            time.sleep(0.05)
+        assert "loop://rw04" not in health.open_peers()
+        assert metrics.snapshot().get(
+            "transport.peer.recovered", 0
+        ) > before.get("transport.peer.recovered", 0)
+    finally:
+        victim.start()  # idempotent re-register
+        health.enabled, health.threshold, health.open_secs = old
+        health.reset()
+
+
+def test_sync_round_abort_failpoint(cluster):
+    import random
+
+    from bftkv_tpu.sync import SyncDaemon
+
+    srv = cluster.server_named("rw03")
+    fp.arm(25)
+    fp.registry.add("sync.round", "abort", match={"node": "rw03"}, rule_id="a")
+    d = SyncDaemon(srv, interval=999, rng=random.Random(1))
+    stats = d.run_round()
+    assert stats.get("aborted") == 1
+    assert stats["peers"] == 0
+
+
+def test_admission_error_failpoint_is_masked_by_quorum(cluster):
+    """An injected admission error on one replica (error reply on every
+    write) is just another faulty replica to the quorum."""
+    cl = cluster.clients[0]
+    fp.arm(26)
+    fp.registry.add(
+        "server.admission",
+        "error",
+        match={"node": "rw02", "cmd": "write"},
+        error="permission denied",
+        rule_id="adm",
+    )
+    cl.write(b"fp_adm", b"ok")
+    assert cl.read(b"fp_adm") == b"ok"
+
+
+def test_colluder_program_equivalent_to_malserver(cluster):
+    """The sign-anything colluder expressed as a failpoint program: an
+    honest writer still commits and reads correctly (the colluder's
+    unverified shares/stores create no authority)."""
+    cl = cluster.clients[0]
+    fp.arm(27)
+    rules = byz.make_colluder(fp.registry, "rw01")
+    try:
+        cl.write(b"fp_byz", b"honest")
+        assert cl.read(b"fp_byz") == b"honest"
+    finally:
+        fp.registry.remove_all(rules)
+    assert any(r.fires for r in rules)  # the program actually ran
+
+
+def test_custom_registry_dispatches_through_fire():
+    """A harness-owned FaultRegistry becomes the active one on arm():
+    hook sites (module-level fire) must see its rules."""
+    reg = fp.FaultRegistry()
+    reg.arm(5)
+    try:
+        reg.add("transport.send", "drop", rule_id="mine")
+        act = fp.fire("transport.send", dst="anything")
+        assert act is not None and act.rule.rule_id == "mine"
+        assert [e.rule_id for e in reg.trace()] == ["mine"]
+        assert fp.registry.trace() == []  # the singleton saw nothing
+    finally:
+        reg.disarm()
+    assert fp.fire("transport.send", dst="anything") is None
+
+
+def test_answered_error_closes_open_circuit():
+    """A peer whose circuit opened while it was down must close it the
+    moment it ANSWERS — even when the answer is an interned protocol
+    error (a reply proves reachability)."""
+    from bftkv_tpu.errors import ERR_PERMISSION_DENIED
+    from bftkv_tpu.transport import _send
+
+    health = tp.peer_health
+    old = (health.enabled, health.threshold, health.open_secs)
+    health.enabled, health.threshold, health.open_secs = True, 2, 0.05
+    health.reset()
+
+    class _Tr:
+        def post(self, url, data):
+            raise ERR_PERMISSION_DENIED
+
+    try:
+        for _ in range(2):
+            health.fail("loop://p1")
+        assert "loop://p1" in health.open_peers()
+        time.sleep(0.06)  # half-open window
+        with pytest.raises(ERR_PERMISSION_DENIED):
+            _send(_Tr(), "loop://p1/bftkv/v1/read", b"x", "read", "loop://p1")
+        assert "loop://p1" not in health.open_peers()
+    finally:
+        health.enabled, health.threshold, health.open_secs = old
+        health.reset()
