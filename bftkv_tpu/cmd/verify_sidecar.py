@@ -211,7 +211,13 @@ def serve(
         host, _, port = listen.rpartition(":")
         srv = _Server((host or "127.0.0.1", int(port)), _Handler)
     kw = {} if max_wait is None else {"max_wait": max_wait}
-    srv.dispatcher = dispatch.VerifyDispatcher(max_batch=max_batch, **kw).start()
+    # calibrate=False: a sidecar exists BECAUSE it owns a crypto
+    # device; the install-time host/device calibration is for
+    # in-process dispatchers sharing a general-purpose host.  The
+    # verifier's own host_threshold still routes tiny batches to host.
+    srv.dispatcher = dispatch.VerifyDispatcher(
+        max_batch=max_batch, calibrate=False, **kw
+    ).start()
     srv.max_frame = max_frame
     srv.secret = secret
     t = threading.Thread(target=srv.serve_forever, daemon=True)

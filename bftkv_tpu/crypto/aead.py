@@ -78,14 +78,13 @@ class _FallbackAEAD:
         self._mac = hashlib.sha256(b"bftkv aead mac\x00" + bytes(key)).digest()
 
     def _keystream(self, nonce: bytes, n: int) -> bytes:
-        out = bytearray()
-        block = 0
-        while len(out) < n:
-            out += hashlib.sha256(
-                self._enc + nonce + struct.pack(">Q", block)
-            ).digest()
-            block += 1
-        return bytes(out[:n])
+        # SHAKE-256 as the keystream XOF: ONE C call for the whole
+        # stream.  The old per-32-byte SHA-256 counter loop cost ~1 C
+        # call per 32 bytes — measured at roughly a quarter of all
+        # write-path CPU at 1 KB values (~6x slower than the XOF).
+        # Construction change is fallback-internal; the all-nodes-same-
+        # stack deployment rule (module doc) is unchanged.
+        return hashlib.shake_256(self._enc + nonce).digest(n)
 
     def _tag(self, nonce: bytes, ct: bytes, aad: bytes | None) -> bytes:
         aad = aad or b""

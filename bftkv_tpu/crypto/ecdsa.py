@@ -27,6 +27,7 @@ import os
 import secrets as pysecrets
 from dataclasses import dataclass
 
+from bftkv_tpu.crypto import rng
 from bftkv_tpu.crypto import ec
 
 __all__ = [
@@ -180,7 +181,7 @@ def sign_batch(messages: list[bytes], key: ECPrivateKey) -> list[bytes]:
     )
     if len(messages) < threshold:
         return [sign(m, key) for m in messages]
-    hedge = os.urandom(32)
+    hedge = rng.generate_random(32)
     es = [_msg_scalar(m, n) for m in messages]
     ks = [_rfc6979_k(e, key.d, n, extra=hedge) for e in es]
     from bftkv_tpu.ops import ec as ec_ops
@@ -309,7 +310,7 @@ def ecies_wrap(secret: bytes, recipient: ECPublicKey) -> bytes:
     shared = shared_pt[0].to_bytes(32, "big")
     eph_pub = eph.public.marshal()
     key = _kdf(shared, eph_pub, recipient.marshal())
-    nonce = os.urandom(12)
+    nonce = rng.generate_random(12)
     return eph_pub + nonce + AESGCM(key).encrypt(nonce, secret, b"ecies")
 
 

@@ -50,12 +50,12 @@ from __future__ import annotations
 
 import hashlib
 import io
-import os
 import struct
 import threading
 from collections import OrderedDict
 
 from bftkv_tpu.crypto import cert as certmod
+from bftkv_tpu.crypto import rng
 from bftkv_tpu.crypto import rsa
 from bftkv_tpu.crypto.aead import AESGCM
 from bftkv_tpu.crypto.aead import _xor as _bxor
@@ -129,7 +129,7 @@ def _oaep_wrap_py(n: int, e: int, secret: bytes) -> bytes:
         raise ValueError("oaep: message too long")
     ps = b"\x00" * (k - len(secret) - 2 * _HLEN - 2)
     db = _LHASH + ps + b"\x01" + secret
-    seed = os.urandom(_HLEN)
+    seed = rng.generate_random(_HLEN)
     masked_db = _bxor(db, _mgf1(seed, k - _HLEN - 1))
     masked_seed = _bxor(seed, _mgf1(masked_db, _HLEN))
     em = int.from_bytes(b"\x00" + masked_seed + masked_db, "big")
@@ -263,21 +263,68 @@ class MessageSecurity:
                 )
         return self._encrypt_bootstrap(recipients, plaintext, nonce)
 
+    def encrypt_grouped(
+        self,
+        recipients: list[certmod.Certificate],
+        plaintext: bytes,
+        nonce: bytes,
+    ) -> list[bytes]:
+        """Per-recipient envelopes for ONE shared plaintext, sealed at
+        most twice: one session envelope covering every recipient that
+        holds a pairwise session, one bootstrap envelope covering the
+        rest.  ``encrypt`` degrades the whole set to the bootstrap path
+        (RSA sign + per-recipient OAEP) whenever ANY recipient lacks a
+        session — so a single cold or restarted peer in a quorum made
+        every round re-encrypt for everyone.  The multicast fan-out
+        uses this instead (transport.multicast, single-payload mode).
+
+        Returns one cipher blob per recipient, aligned with
+        ``recipients``; group members share the identical object."""
+        with self._lock:
+            sessions = [self._by_peer.get(r.id) for r in recipients]
+        warm = [
+            (i, s) for i, s in enumerate(sessions) if s is not None
+        ]
+        cold = [i for i, s in enumerate(sessions) if s is None]
+        if not cold:
+            cipher = self._encrypt_session(
+                recipients, [s for _, s in warm], plaintext, nonce
+            )
+            return [cipher] * len(recipients)
+        if not warm:
+            cipher = self._encrypt_bootstrap(recipients, plaintext, nonce)
+            return [cipher] * len(recipients)
+        out: list[bytes | None] = [None] * len(recipients)
+        warm_cipher = self._encrypt_session(
+            [recipients[i] for i, _ in warm],
+            [s for _, s in warm],
+            plaintext,
+            nonce,
+        )
+        cold_cipher = self._encrypt_bootstrap(
+            [recipients[i] for i in cold], plaintext, nonce
+        )
+        for i, _ in warm:
+            out[i] = warm_cipher
+        for i in cold:
+            out[i] = cold_cipher
+        return out
+
     def _encrypt_session(
         self, recipients, sessions: list[_SessionOut], plaintext, nonce
     ) -> bytes:
         inner = io.BytesIO()
         write_chunk(inner, plaintext)
         write_chunk(inner, nonce)
-        content_key = os.urandom(32)
-        gcm_nonce = os.urandom(12)
+        content_key = rng.generate_random(32)
+        gcm_nonce = rng.generate_random(12)
         ct = AESGCM(content_key).encrypt(gcm_nonce, inner.getvalue(), b"data")
 
         out = io.BytesIO()
         out.write(bytes([_TAG_SESSION]))
         out.write(struct.pack(">H", len(recipients)))
         for r, s in zip(recipients, sessions):
-            kw_nonce = os.urandom(12)
+            kw_nonce = rng.generate_random(12)
             kw = AESGCM(s.key).encrypt(
                 kw_nonce, content_key, b"kw" + bytes([s.role])
             )
@@ -292,8 +339,8 @@ class MessageSecurity:
         grants = io.BytesIO()
         new_sessions: list[tuple[int, _SessionOut, certmod.Certificate]] = []
         for r in recipients:
-            sid = os.urandom(16)
-            skey = os.urandom(32)
+            sid = rng.generate_random(16)
+            skey = rng.generate_random(32)
             grants.write(struct.pack(">Q", r.id))
             write_chunk(grants, sid)
             write_chunk(grants, _wrap_to(r, skey))
@@ -317,8 +364,8 @@ class MessageSecurity:
         signed.write(body)
         write_chunk(signed, sig)
 
-        content_key = os.urandom(32)
-        gcm_nonce = os.urandom(12)
+        content_key = rng.generate_random(32)
+        gcm_nonce = rng.generate_random(12)
         ct = AESGCM(content_key).encrypt(gcm_nonce, signed.getvalue(), None)
 
         out = io.BytesIO()

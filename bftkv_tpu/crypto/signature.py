@@ -24,11 +24,13 @@ import struct
 
 from bftkv_tpu.crypto import cert as certmod
 from bftkv_tpu.crypto import rsa
+from bftkv_tpu.crypto import vcache
 from bftkv_tpu.errors import (
     ERR_CERTIFICATE_NOT_FOUND,
     ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES,
     ERR_INVALID_SIGNATURE,
 )
+from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.packet import (
     SIGNATURE_TYPE_NATIVE,
     SignaturePacket,
@@ -97,14 +99,26 @@ class Signer:
         # stop serializing on the GIL.  Signing stays host-side
         # otherwise: a sidecar-mode daemon must never initialize the
         # accelerator the sidecar owns.
-        if (d := dispatch.get_signer()) is not None:
+        d = dispatch.get_signer()
+        if d is not None and not d.prefer_host(len(tbs_list)):
             sigs = d.submit([(tbs, self.key) for tbs in tbs_list])
         elif certmod.is_ec(self.key):
             from bftkv_tpu.crypto import ecdsa as _ecdsa
 
             sigs = [_ecdsa.sign(tbs, self.key) for tbs in tbs_list]
+        elif d is not None:
+            # Calibration says these items end on host either way
+            # (ops/dispatch.py install-time crossover): sign inline and
+            # skip the collector wait + flush queue entirely.
+            metrics.incr("sign.host", len(tbs_list))
+            sigs = [rsa.sign(tbs, self.key) for tbs in tbs_list]
         else:
             sigs = [rsa.sign(tbs, self.key) for tbs in tbs_list]
+        # Seed the verify memo: a signature this process just produced
+        # with its own key verifies under its own certificate by the
+        # scheme's correctness (crypto/vcache.py).
+        for tbs, sig in zip(tbs_list, sigs):
+            vcache.seed_own_signature(self.cert, tbs, sig)
         cert_bytes = self.cert.serialize() if include_cert else None
         return [
             SignaturePacket(
@@ -153,14 +167,27 @@ class CollectiveSignature:
     def __init__(self, verifier: rsa.VerifierDomain | None = None):
         self.verifier = verifier or rsa.VerifierDomain()
 
-    def verify(self, tbss: bytes, ss: SignaturePacket | None, quorum, keyring) -> None:
+    def verify(
+        self,
+        tbss: bytes,
+        ss: SignaturePacket | None,
+        quorum,
+        keyring,
+        *,
+        use_cache: bool = True,
+    ) -> None:
         """Raise unless enough *distinct, quorum-member* signers verify.
 
         One TPU batch over every entry — all signatures verify in a
         single kernel launch.  (One-job form of :meth:`verify_many`, so
         the single and batch write paths share one semantics.)
+
+        ``use_cache=False`` bypasses the verified-signature memo
+        (crypto/vcache.py) — required for TPA-protected records.
         """
-        err = self.verify_many([(tbss, ss)], quorum, keyring)[0]
+        err = self.verify_many(
+            [(tbss, ss)], quorum, keyring, use_cache=use_cache
+        )[0]
         if err is not None:
             raise err
 
@@ -169,21 +196,30 @@ class CollectiveSignature:
         jobs: list[tuple[bytes, SignaturePacket | None]],
         quorum,
         keyring,
+        *,
+        use_cache: bool = True,
     ) -> list[Exception | type | None]:
         """Batched form of :meth:`verify` for the batch write pipeline:
         every entry of every job rides in ONE device batch; returns one
-        error (or ``None``) per job instead of raising."""
+        error (or ``None``) per job instead of raising.
+
+        Entries whose exact (signer key, tbs, sig) triple is memoized as
+        a past SUCCESSFUL verify (crypto/vcache.py) skip the device
+        batch; fresh successes are memoized.  Only the math is cached —
+        quorum sufficiency over the valid signer set is recomputed here
+        on every call."""
         from bftkv_tpu.ops import dispatch
 
+        use_cache = use_cache and vcache.enabled()
         results: list[Exception | type | None] = [None] * len(jobs)
         items: list[tuple[bytes, bytes, rsa.PublicKey]] = []
-        spans: list[tuple[int, list[certmod.Certificate]]] = []
+        # Per job: [(cert, sig, items-index or -1 for a memo hit)].
+        jobmeta: list[list[tuple]] = []
         # One batch's jobs typically embed the SAME merged cert set in
         # every item; parse each distinct byte string once per call.
         cert_cache: dict[bytes, dict[int, certmod.Certificate]] = {}
         for j, (tbss, ss) in enumerate(jobs):
-            certs: list[certmod.Certificate] = []
-            start = len(items)
+            meta: list[tuple] = []
             try:
                 entries = parse_entries(ss.data if ss else None)
                 if ss is None or not ss.cert:
@@ -197,14 +233,17 @@ class CollectiveSignature:
                     c = _resolve_cert(signer_id, keyring, embedded)
                     if c is None:
                         continue
-                    items.append((tbss, sig, c.public_key))
-                    certs.append(c)
+                    if use_cache and vcache.get(c, tbss, sig):
+                        meta.append((c, sig, -1))
+                    else:
+                        meta.append((c, sig, len(items)))
+                        items.append((tbss, sig, c.public_key))
             except Exception:
                 results[j] = ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES
-                spans.append((start, []))
+                jobmeta.append([])
                 continue
-            spans.append((start, certs))
-            if not certs:
+            jobmeta.append(meta)
+            if not meta:
                 results[j] = ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES
         if items:
             d = dispatch.get()
@@ -215,12 +254,18 @@ class CollectiveSignature:
             )
         else:
             ok = []
-        for j, (start, certs) in enumerate(spans):
+        for j, meta in enumerate(jobmeta):
             if results[j] is not None:
                 continue
-            valid = {
-                c for c, good in zip(certs, ok[start : start + len(certs)]) if good
-            }
+            tbss = jobs[j][0]
+            valid: set = set()
+            for c, sig, idx in meta:
+                if idx < 0:
+                    valid.add(c)
+                elif ok[idx]:
+                    valid.add(c)
+                    if use_cache:
+                        vcache.put(c, tbss, sig)
             if not quorum.is_sufficient(list(valid)):
                 results[j] = ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES
         return results
@@ -280,16 +325,29 @@ class CollectiveSignature:
 
 
 def verify_with_certificate(
-    tbs: bytes, pkt: SignaturePacket | None, certificate: certmod.Certificate
+    tbs: bytes,
+    pkt: SignaturePacket | None,
+    certificate: certmod.Certificate,
+    *,
+    use_cache: bool = True,
 ) -> None:
     """Verify a single-signer packet against a known certificate, in the
     certificate's own algorithm (reference: crypto/crypto.go:60, used by
-    server.go:207; algorithm dispatch per crypto_pgp.go:310-405)."""
+    server.go:207; algorithm dispatch per crypto_pgp.go:310-405).
+
+    Consults the verified-signature memo (crypto/vcache.py) unless
+    ``use_cache=False``; only a SUCCESS is ever memoized — a failed
+    verify raises without touching the cache."""
     if pkt is None or not pkt.data:
         raise ERR_INVALID_SIGNATURE
+    use_cache = use_cache and vcache.enabled()
     for sid, sig in parse_entries(pkt.data):
         if sid == certificate.id:
+            if use_cache and vcache.get(certificate, tbs, sig):
+                return
             if certmod.verify_detached(tbs, sig, certificate):
+                if use_cache:
+                    vcache.put(certificate, tbs, sig)
                 return
             raise ERR_INVALID_SIGNATURE
     raise ERR_INVALID_SIGNATURE

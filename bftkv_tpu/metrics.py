@@ -72,7 +72,18 @@ def _prom_value(v) -> str:
 class Metrics:
     def __init__(self):
         self._lock = threading.Lock()
-        self._counters: dict[tuple, int] = defaultdict(int)
+        # Counters are sharded PER THREAD: ``incr`` is the hottest call
+        # in the process (several per RPC from every handler, fan-out
+        # worker and writer thread), and a single shared lock made each
+        # contended acquire a blocking GIL round trip — profiled at
+        # ~14 ms per blocked incr on the cluster_4 bench.  Each thread
+        # mutates only its own dict (GIL-atomic for str/tuple keys);
+        # readers sum the shards.  Totals are exact at read time.
+        # Shards of finished threads stay in the list (their counts
+        # must keep counting); growth is bounded by the process's peak
+        # thread count, and the fan-out pool reuses threads.
+        self._tl = threading.local()
+        self._counter_shards: list[dict] = []
         self._gauges: dict[tuple, float] = {}
         self._counts: dict[tuple, int] = defaultdict(int)
         self._sums: dict[tuple, float] = defaultdict(float)
@@ -84,9 +95,27 @@ class Metrics:
         self._sample_pos: dict[tuple, int] = defaultdict(int)
         self._max_samples = 65536
 
-    def incr(self, name: str, n: int = 1, labels: dict | None = None) -> None:
+    def _local_counters(self) -> dict:
+        d = getattr(self._tl, "counters", None)
+        if d is None:
+            d = self._tl.counters = defaultdict(int)
+            with self._lock:
+                self._counter_shards.append(d)
+        return d
+
+    def _counter_totals(self) -> dict:
+        totals: dict[tuple, int] = defaultdict(int)
         with self._lock:
-            self._counters[_key(name, labels)] += n
+            shards = list(self._counter_shards)
+        for d in shards:
+            # dict.copy() is a single C-level operation under the GIL,
+            # so a concurrently-incrementing owner thread cannot tear it.
+            for k, v in d.copy().items():
+                totals[k] += v
+        return dict(totals)
+
+    def incr(self, name: str, n: int = 1, labels: dict | None = None) -> None:
+        self._local_counters()[_key(name, labels)] += n
 
     def gauge(
         self, name: str, value: float, labels: dict | None = None
@@ -147,11 +176,11 @@ class Metrics:
         return s[i]
 
     def snapshot(self) -> dict:
+        counters = self._counter_totals()
         with self._lock:
             # Copy everything under the lock — concurrent incr/observe
             # of a *new* name would otherwise mutate dicts
             # mid-iteration — but sort OUTSIDE it (see percentile()).
-            counters = dict(self._counters)
             gauges = dict(self._gauges)
             counts = dict(self._counts)
             sums = dict(self._sums)
@@ -179,8 +208,8 @@ class Metrics:
         Counter names end in ``_total``; ``observe()`` series render as
         summaries (``{quantile="..."}`` samples over the recent window,
         ``_sum``/``_count`` over the whole run); gauges are plain."""
+        counters = self._counter_totals()
         with self._lock:
-            counters = dict(self._counters)
             gauges = dict(self._gauges)
             counts = dict(self._counts)
             sums = dict(self._sums)
@@ -231,12 +260,14 @@ class Metrics:
 
     def reset(self) -> None:
         with self._lock:
-            self._counters.clear()
+            shards = list(self._counter_shards)
             self._gauges.clear()
             self._counts.clear()
             self._sums.clear()
             self._samples.clear()
             self._sample_pos.clear()
+        for d in shards:
+            d.clear()
 
 
 registry = Metrics()

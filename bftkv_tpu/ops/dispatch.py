@@ -58,6 +58,90 @@ __all__ = [
 ]
 
 
+#: Sentinel crossover meaning "the device never wins for this backend".
+ALWAYS_HOST = 1 << 30
+
+_CALIBRATION: dict | None = None
+_calibration_lock = threading.Lock()
+
+
+def calibration(force: bool = False) -> dict:
+    """Measured host-verify cost vs device launch RTT, once per process.
+
+    The host/device crossover used to be a hard-coded constant
+    (``VerifierDomain.HOST_CROSSOVER = 192``), which is wrong in both
+    directions: on a locally-attached accelerator the launch RTT is a
+    few ms, so protocol-sized batches (~24 items at cluster_4) should
+    engage the device but never reached the constant; on a CPU backend
+    the XLA kernels are slower than host ``pow`` at EVERY batch size
+    (the RNS kernels are MXU-shaped), so the constant let 16-writer
+    bursts cross it and sink whole seconds into CPU-XLA flushes
+    (BENCH_r05: 1,126 device signs on the CPU fallback).
+
+    Measures (a) per-item host e=65537 verify cost via raw ``pow`` on a
+    fixed 2048-bit modulus and (b) the device launch round trip via a
+    trivial jitted op on device-resident operands — a lower bound on
+    any real kernel launch.  ``crossover ≈ rtt / host_per_item`` is the
+    batch size where one launch starts beating the host loop.  On a CPU
+    "device" the kernels themselves lose to host ``pow`` regardless of
+    batch, so the crossover pins to :data:`ALWAYS_HOST`.
+    """
+    global _CALIBRATION
+    with _calibration_lock:
+        if _CALIBRATION is not None and not force:
+            return _CALIBRATION
+        import jax
+
+        backend = jax.default_backend()
+        # Host per-item cost: raw pow on a fixed odd 2048-bit modulus —
+        # the dominant term of a host verify, no keygen required.
+        n = (1 << 2047) + 973  # odd, full-width; exactness is irrelevant
+        s = (1 << 2040) // 7
+        t0 = time.perf_counter()
+        reps = 12
+        for _ in range(reps):
+            pow(s, 65537, n)
+        host_s = (time.perf_counter() - t0) / reps
+        if backend == "cpu":
+            cal = {
+                "backend": backend,
+                "host_verify_s": host_s,
+                "device_rtt_s": None,
+                "verify_crossover": ALWAYS_HOST,
+                "sign_crossover": ALWAYS_HOST,
+                "prefer_host": True,
+            }
+        else:
+            import jax.numpy as jnp
+
+            f = jax.jit(lambda x: x * 2 + 1)
+            x = jax.device_put(jnp.zeros((256, 128), jnp.uint32))
+            jax.block_until_ready(f(x))  # compile outside the timing
+            t0 = time.perf_counter()
+            for _ in range(3):
+                jax.block_until_ready(f(x))
+            rtt = (time.perf_counter() - t0) / 3
+            cal = {
+                "backend": backend,
+                "host_verify_s": host_s,
+                "device_rtt_s": rtt,
+                # Floor of 16 so a noisy fast-RTT measurement cannot
+                # push tiny batches onto the device.
+                "verify_crossover": max(16, int(rtt / max(host_s, 1e-7))),
+                # Sign launches are far heavier than the probe op;
+                # keep the signer's proven default on real devices.
+                "sign_crossover": None,
+                "prefer_host": False,
+            }
+        metrics.gauge(
+            "dispatch.crossover",
+            -1 if cal["verify_crossover"] == ALWAYS_HOST
+            else cal["verify_crossover"],
+        )
+        _CALIBRATION = cal
+        return cal
+
+
 class _Pending:
     __slots__ = ("items", "event", "result", "error")
 
@@ -94,17 +178,26 @@ class _BatchDispatcher:
         max_batch: int = 1024,
         max_wait: float = 0.002,
         pipeline: int | None = None,
+        calibrate: bool | None = None,
     ):
         import os
 
         self.max_batch = max_batch
         self.max_wait = max_wait
+        if calibrate is None:
+            calibrate = os.environ.get("BFTKV_DISPATCH_CALIBRATE", "1") != "0"
+        self._calibrate = calibrate
+        #: True once install-time calibration decides the host beats a
+        #: device launch at ANY batch this backend can see — call sites
+        #: (``Signer.issue_many``, :meth:`VerifyDispatcher.verify`) then
+        #: skip the collector wait + flush queue and run host inline.
+        self._prefer_host = False
         if pipeline is None:
             env = os.environ.get("BFTKV_DISPATCH_PIPELINE")
             pipeline = int(env) if env else None
         self.pipeline = max(1, pipeline) if pipeline is not None else None
         self._inflight: threading.BoundedSemaphore | None = None
-        self._work: "queue.Queue[list[_Pending] | None]" | None = None
+        self._work: "queue.SimpleQueue[list[_Pending] | None]" | None = None
         self._workers: list[threading.Thread] = []
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
@@ -118,6 +211,11 @@ class _BatchDispatcher:
     def _run_batch(self, items: list):
         """One batched launch; returns a sequence aligned with items."""
         raise NotImplementedError
+
+    def prefer_host(self, n_items: int) -> bool:
+        """True when calibration proved these items end on host either
+        way, so the caller should skip the dispatcher round trip."""
+        return self._prefer_host
 
     def _combine(self, chunks: list):
         return np.concatenate(chunks)
@@ -149,7 +247,7 @@ class _BatchDispatcher:
             # handed off but not yet flushed, so the collector stalls
             # — and submits keep coalescing — when the pipeline is full.
             self._inflight = threading.BoundedSemaphore(self.pipeline)
-            self._work = queue.Queue()
+            self._work = queue.SimpleQueue()
             self._workers = [
                 threading.Thread(
                     target=self._flush_worker,
@@ -344,9 +442,13 @@ class VerifyDispatcher(_BatchDispatcher):
         max_batch: int = 1024,
         max_wait: float = 0.002,
         pipeline: int | None = None,
+        calibrate: bool | None = None,
     ):
         super().__init__(
-            max_batch=max_batch, max_wait=max_wait, pipeline=pipeline
+            max_batch=max_batch,
+            max_wait=max_wait,
+            pipeline=pipeline,
+            calibrate=calibrate,
         )
         if verifier is None:
             from bftkv_tpu.crypto import rsa as rsamod
@@ -354,10 +456,28 @@ class VerifyDispatcher(_BatchDispatcher):
             verifier = rsamod.VerifierDomain()
         self.verifier = verifier
 
+    def start(self):
+        super().start()
+        if self._calibrate:
+            import os
+
+            cal = calibration()
+            # An explicit env threshold is the operator's word and
+            # outranks the measurement.
+            if os.environ.get("BFTKV_HOST_VERIFY_THRESHOLD") is None:
+                self.verifier.host_threshold = cal["verify_crossover"]
+            self._prefer_host = cal["prefer_host"]
+        return self
+
     def _run_batch(self, items: list):
         return self.verifier.verify_batch(items)
 
     def verify(self, items: list) -> np.ndarray:
+        if self._prefer_host:
+            # Calibrated all-host backend: the flush would run the same
+            # host loop anyway; inline skips max_wait + queueing.
+            metrics.incr("dispatch.verifies", len(items))
+            return self.verifier.verify_batch(items)
         out = self.submit(items)
         metrics.incr("dispatch.verifies", len(items))
         return out
@@ -390,17 +510,36 @@ class SignDispatcher(_BatchDispatcher):
         max_batch: int = 1024,
         max_wait: float | None = None,
         pipeline: int | None = None,
+        calibrate: bool | None = None,
     ):
         super().__init__(
             max_batch=max_batch,
             max_wait=self.DEFAULT_MAX_WAIT if max_wait is None else max_wait,
             pipeline=pipeline,
+            calibrate=calibrate,
         )
         if signer is None:
             from bftkv_tpu.crypto import rsa as rsamod
 
             signer = rsamod.SignerDomain()
         self.signer = signer
+
+    def start(self):
+        super().start()
+        if self._calibrate:
+            import os
+
+            cal = calibration()
+            self._prefer_host = cal["prefer_host"]
+            if (
+                cal["sign_crossover"] is not None
+                and os.environ.get("BFTKV_HOST_SIGN_THRESHOLD") is None
+            ):
+                # CPU backend: any flush that still lands here (e.g. a
+                # caller ignoring prefer_host) must host-sign rather
+                # than sink seconds into a CPU-XLA modexp launch.
+                self.signer.host_threshold = cal["sign_crossover"]
+        return self
 
     def _run_batch(self, items: list):
         from bftkv_tpu.crypto import cert as certmod

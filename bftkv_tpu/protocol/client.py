@@ -29,6 +29,7 @@ from bftkv_tpu import transport as tp
 from bftkv_tpu.crypto import auth as authmod
 from bftkv_tpu.crypto import cert as certmod
 from bftkv_tpu.crypto import signature as sigmod
+from bftkv_tpu.crypto import vcache
 from bftkv_tpu.crypto.threshold import ThresholdAlgo, serialize_params
 from bftkv_tpu.errors import (
     error_from_string,
@@ -49,6 +50,41 @@ from bftkv_tpu.protocol import MAX_UINT64, Protocol, Ref, majority_error
 __all__ = ["Client", "MAX_UINT64"]
 
 log = logging.getLogger("bftkv_tpu.protocol.client")
+
+import os as _os
+
+#: Sign rounds fan out to a minimal sufficient prefix first (one
+#: private-key op saved per skipped replica per write); ``full``
+#: restores the reference's ask-everyone shape.
+_STAGED_SIGN_FANOUT = (
+    _os.environ.get("BFTKV_SIGN_FANOUT", "staged") != "full"
+)
+
+#: write_many pipelining: at most this many chunk write-rounds in
+#: flight behind the caller thread's time+sign rounds (1 disables).
+_WRITE_PIPELINE_WINDOW = int(
+    _os.environ.get("BFTKV_WRITE_PIPELINE", "2") or 2
+)
+#: Chunk floor — batches at or below this size stay monolithic, so the
+#: server-side device launches stay amortized.
+_WRITE_PIPELINE_CHUNK = int(
+    _os.environ.get("BFTKV_WRITE_CHUNK", "256") or 256
+)
+
+
+def _staged_wave(qa) -> tuple[list, list]:
+    """(wave1, rest) for a staged sign fan-out: the minimal prefix of
+    the quorum whose full success would already be sufficient, and the
+    remainder to ask only on shortfall.  Degenerates to (all, [])
+    when staging is disabled or no prefix suffices."""
+    nodes = qa.nodes()
+    if _STAGED_SIGN_FANOUT:
+        prefix: list = []
+        for nd in nodes:
+            prefix.append(nd)
+            if qa.is_sufficient(prefix):
+                return prefix, nodes[len(prefix) :]
+    return nodes, []
 
 
 class _SignedValue:
@@ -253,6 +289,7 @@ class Client(Protocol):
             # (reference: client.go:142).
             req = pkt.serialize(variable, value, t, sig, proof)
             ss = None
+            done_flag = [False]
             failure: list = []
             errs: list = []
 
@@ -265,6 +302,7 @@ class Client(Protocol):
                         ss, done = self.crypt.collective.combine(
                             ss, share, qa, self.crypt.keyring
                         )
+                        done_flag[0] = done
                         return done
                     except Exception as e:
                         err = e
@@ -274,7 +312,21 @@ class Client(Protocol):
                 failure.append(res.peer)
                 return qa.reject(failure)
 
-            self.tr.multicast(tp.SIGN, qa.nodes(), req, cb)
+            # Staged fan-out: ask a minimal sufficient prefix first and
+            # expand to the rest only if it does not complete.  Every
+            # share costs the responder a private-key operation, so the
+            # reference's ask-everyone fan-out burns (n - suff) signs
+            # per write for shares the combine then discards; safety is
+            # untouched — equivocation protection comes from sufficient
+            # signer sets intersecting in an honest node, not from how
+            # many replicas were *asked* (DESIGN.md §9).  A fault in
+            # the first wave costs one extra round to the remainder
+            # (BFTKV_SIGN_FANOUT=full restores the old behavior).
+            wave1, rest = _staged_wave(qa)
+            self.tr.multicast(tp.SIGN, wave1, req, cb)
+            if not done_flag[0] and rest:
+                metrics.incr("client.sign.fanout_expanded")
+                self.tr.multicast(tp.SIGN, rest, req, cb)
             with trace.span("verify.collective"):
                 try:
                     self.crypt.collective.verify(
@@ -287,7 +339,7 @@ class Client(Protocol):
     # -- batched write pipeline (no reference analog) ---------------------
 
     def write_many(
-        self, items: list[tuple[bytes, bytes]], proof=None
+        self, items: list[tuple[bytes, bytes]], proof=None, *, window=None
     ) -> list[Exception | None]:
         """Batched three-phase signed write of B *distinct* variables.
 
@@ -298,6 +350,18 @@ class Client(Protocol):
         every signature operation (client TBS signing, server writer-sig
         verification, server share issuance, collective verification)
         runs as one device batch instead of B×n individual calls.
+
+        Large batches run as a **pipelined** sequence of chunks: chunk
+        k's write round (the BATCH_WRITE fan-out and its threshold
+        wait) runs on a background worker while chunk k+1's time+sign
+        rounds proceed on the caller thread, with at most ``window``
+        write rounds in flight (default 2, ``BFTKV_WRITE_PIPELINE``).
+        Chunks are a latency/occupancy trade: each chunk's server-side
+        crypto still batches into shared device launches, and the
+        chunk floor (``BFTKV_WRITE_CHUNK``, default 256) keeps those
+        launches amortized.  Items within a chunk keep exactly the
+        monolithic path's semantics; chunks touch disjoint variables
+        (enforced below), so inter-chunk ordering is immaterial.
 
         Returns a list aligned with ``items``: ``None`` per success, the
         per-item error otherwise.
@@ -310,182 +374,254 @@ class Client(Protocol):
             # other at the same timestamp; that is a caller bug.
             raise ValueError("write_many: duplicate variables in one batch")
         n = len(items)
-        results: list[Exception | None] = [None] * n
 
+        if window is None:
+            window = _WRITE_PIPELINE_WINDOW
+        chunk_size = _WRITE_PIPELINE_CHUNK
         with metrics.timer("client.write_many.latency"), trace.span(
             "client.write_many", attrs={"batch": n}
         ):
-            # ---- phase 1: timestamps (reference: client.go:62-92) ----
-            with trace.span("quorum.select"):
-                qr = self.qs.choose_quorum(qm.READ | qm.AUTH)
-            maxts = [0] * n
-            tally = _BatchTally(n, qr.is_threshold, qr.reject)
-
-            def on_time(i: int, payload: bytes):
-                # Same strictness as the single path (`res.data and
-                # len(res.data) <= 8`): an empty or oversized timestamp
-                # is a failed response, not t=0 — a Byzantine replica
-                # must not pad the quorum with vacuous answers.
-                if not payload or len(payload) > 8:
-                    return ERR_INVALID_TIMESTAMP
-                t = int.from_bytes(payload, "big")
-                if t > maxts[i]:
-                    maxts[i] = t
-                return None
-
-            with metrics.timer("client.write_many.phase_time"), trace.span(
-                "phase.time", attrs={"peers": len(qr.nodes())}
-            ):
-                self.tr.multicast(
-                    tp.BATCH_TIME,
-                    qr.nodes(),
-                    pkt.serialize_list(variables),
-                    _batch_cb(tally, n, on_time),
-                )
-            for i in range(n):
-                err = tally.item_error(i, ERR_INSUFFICIENT_NUMBER_OF_QUORUM)
-                if err is not None:
-                    results[i] = err
-                elif maxts[i] == MAX_UINT64:
-                    results[i] = ERR_INVALID_TIMESTAMP
-
-            # ---- phase 2: sign (reference: client.go:125-170) --------
-            pending = [i for i in range(n) if results[i] is None]
-            if not pending:
+            if window <= 1 or n <= chunk_size:
+                results: list[Exception | None] = [None] * n
+                state = self._wm_time_sign(items, proof, results)
+                if state is not None:
+                    self._wm_write(items, results, *state)
                 return results
-            ts = {i: maxts[i] + 1 for i in pending}
-            tbs_list = [
-                pkt.serialize(items[i][0], items[i][1], ts[i], nfields=3)
-                for i in pending
-            ]
-            with metrics.timer("client.write_many.phase_self_sign"):
-                # The writer cert rides the FIRST item only; servers
-                # resolve embedded certs frame-wide in _batch_sign, so
-                # B−1 cert copies come off the wire and off the
-                # server's parse path.
-                pkts = self.crypt.signer.issue_many(
-                    tbs_list, include_cert=False
-                )
-                if pkts:
-                    pkts[0].cert = self.crypt.signer.cert.serialize()
-                sigs = dict(zip(pending, pkts))
-            reqs = [
-                pkt.serialize(items[i][0], items[i][1], ts[i], sigs[i], proof)
-                for i in pending
-            ]
+            return self._write_many_pipelined(
+                items, proof, window, chunk_size
+            )
 
-            qa = self.qs.choose_quorum(qm.AUTH | qm.PEER)
-            entries: dict[int, dict[int, bytes]] = {i: {} for i in pending}
-            extra_certs: dict[int, object] = {}  # embedded, not in keyring
-            stally = _BatchTally(len(pending), qa.is_sufficient, qa.reject)
+    def _write_many_pipelined(
+        self, items, proof, window: int, chunk_size: int
+    ) -> list:
+        """Chunked 3-stage pipeline: the caller thread drives time+sign
+        rounds chunk by chunk; completed chunks' write rounds run on a
+        background worker, bounded to ``window`` in flight."""
+        n = len(items)
+        results: list[Exception | None] = [None] * n
+        sem = threading.Semaphore(window)
+        workers: list[threading.Thread] = []
+        ctx = trace.capture()
 
-            def on_share(k: int, payload: bytes):
-                # Count only shares whose signer RESOLVES — sufficiency
-                # must track usable signatures, not responding servers,
-                # or an unresolvable (Byzantine) share would stop the
-                # fan-out early and starve verification below quorum.
-                try:
-                    share = pkt.parse_signature(payload)
-                    if share is not None and share.cert:
-                        for c in certmod.parse(share.cert):
-                            if self.crypt.keyring.get(c.id) is None:
-                                extra_certs.setdefault(c.id, c)
-                    added = False
-                    for sid, sb in sigmod.parse_entries(
-                        share.data if share else None
-                    ):
-                        if (
-                            self.crypt.keyring.get(sid) is not None
-                            or sid in extra_certs
-                        ):
-                            entries[pending[k]].setdefault(sid, sb)
-                            added = True
-                    return None if added else _SKIP
-                except Exception as e:
-                    return e
+        def run_write(chunk, chunk_results, state):
+            try:
+                with trace.attach(ctx):
+                    self._wm_write(chunk, chunk_results, *state)
+            except Exception as e:  # defensive: never strand the join
+                for k in range(len(chunk_results)):
+                    if chunk_results[k] is None:
+                        chunk_results[k] = e
+            finally:
+                sem.release()
 
-            with metrics.timer("client.write_many.phase_sign"), trace.span(
-                "phase.sign", attrs={"peers": len(qa.nodes())}
-            ):
-                self.tr.multicast(
-                    tp.BATCH_SIGN,
-                    qa.nodes(),
-                    pkt.serialize_list(reqs),
-                    _batch_cb(stally, len(pending), on_share),
-                )
-            jobs: list[tuple[bytes, pkt.SignaturePacket]] = []
-            jidx: list[int] = []
-            sss: dict[int, pkt.SignaturePacket] = {}
-            for k, i in enumerate(pending):
-                err = stally.item_error(
-                    k, ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES
-                )
-                if err is not None:
-                    results[i] = err
-                    continue
-                embeds = [
-                    extra_certs[sid]
-                    for sid in entries[i]
-                    if sid in extra_certs
-                ]
-                ss = pkt.SignaturePacket(
-                    type=pkt.SIGNATURE_TYPE_NATIVE,
-                    version=1,
-                    completed=True,
-                    data=sigmod.serialize_entries(list(entries[i].items())),
-                    cert=certmod.serialize_many(embeds) if embeds else None,
-                )
-                sss[i] = ss
-                tbss = pkt.serialize(
-                    items[i][0], items[i][1], ts[i], sigs[i], nfields=4
-                )
-                jobs.append((tbss, ss))
-                jidx.append(i)
-            if jobs:
-                with metrics.timer(
-                    "client.write_many.phase_verify"
-                ), trace.span(
-                    "verify.collective", attrs={"batch_size": len(jobs)}
+        spans: list[tuple[int, list]] = []  # (offset, chunk_results)
+        for off in range(0, n, chunk_size):
+            chunk = items[off : off + chunk_size]
+            chunk_results: list = [None] * len(chunk)
+            spans.append((off, chunk_results))
+            state = self._wm_time_sign(chunk, proof, chunk_results)
+            if state is None:
+                continue
+            sem.acquire()
+            metrics.incr("client.write_many.pipelined_chunks")
+            t = threading.Thread(
+                target=run_write,
+                args=(chunk, chunk_results, state),
+                daemon=True,
+            )
+            t.start()
+            workers.append(t)
+        for t in workers:
+            t.join()
+        for off, chunk_results in spans:
+            results[off : off + len(chunk_results)] = chunk_results
+        return results
+
+    def _wm_time_sign(self, items, proof, results):
+        """Phases 1+2 of the batched write for one chunk: timestamps,
+        share collection, collective verification.  Fills ``results``
+        (aligned with ``items``) with per-item errors; returns the
+        phase-3 state ``(pending, ts, sigs, sss)`` or ``None`` when no
+        item survived."""
+        n = len(items)
+        # ---- phase 1: timestamps (reference: client.go:62-92) ----
+        with trace.span("quorum.select"):
+            qr = self.qs.choose_quorum(qm.READ | qm.AUTH)
+        maxts = [0] * n
+        tally = _BatchTally(n, qr.is_threshold, qr.reject)
+
+        def on_time(i: int, payload: bytes):
+            # Same strictness as the single path (`res.data and
+            # len(res.data) <= 8`): an empty or oversized timestamp
+            # is a failed response, not t=0 — a Byzantine replica
+            # must not pad the quorum with vacuous answers.
+            if not payload or len(payload) > 8:
+                return ERR_INVALID_TIMESTAMP
+            t = int.from_bytes(payload, "big")
+            if t > maxts[i]:
+                maxts[i] = t
+            return None
+
+        with metrics.timer("client.write_many.phase_time"), trace.span(
+            "phase.time", attrs={"peers": len(qr.nodes())}
+        ):
+            self.tr.multicast(
+                tp.BATCH_TIME,
+                qr.nodes(),
+                pkt.serialize_list([v for v, _ in items]),
+                _batch_cb(tally, n, on_time),
+            )
+        for i in range(n):
+            err = tally.item_error(i, ERR_INSUFFICIENT_NUMBER_OF_QUORUM)
+            if err is not None:
+                results[i] = err
+            elif maxts[i] == MAX_UINT64:
+                results[i] = ERR_INVALID_TIMESTAMP
+
+        # ---- phase 2: sign (reference: client.go:125-170) --------
+        pending = [i for i in range(n) if results[i] is None]
+        if not pending:
+            return None
+        ts = {i: maxts[i] + 1 for i in pending}
+        tbs_list = [
+            pkt.serialize(items[i][0], items[i][1], ts[i], nfields=3)
+            for i in pending
+        ]
+        with metrics.timer("client.write_many.phase_self_sign"):
+            # The writer cert rides the FIRST item only; servers
+            # resolve embedded certs frame-wide in _batch_sign, so
+            # B−1 cert copies come off the wire and off the
+            # server's parse path.
+            pkts = self.crypt.signer.issue_many(
+                tbs_list, include_cert=False
+            )
+            if pkts:
+                pkts[0].cert = self.crypt.signer.cert.serialize()
+            sigs = dict(zip(pending, pkts))
+        reqs = [
+            pkt.serialize(items[i][0], items[i][1], ts[i], sigs[i], proof)
+            for i in pending
+        ]
+
+        qa = self.qs.choose_quorum(qm.AUTH | qm.PEER)
+        entries: dict[int, dict[int, bytes]] = {i: {} for i in pending}
+        extra_certs: dict[int, object] = {}  # embedded, not in keyring
+        stally = _BatchTally(len(pending), qa.is_sufficient, qa.reject)
+
+        def on_share(k: int, payload: bytes):
+            # Count only shares whose signer RESOLVES — sufficiency
+            # must track usable signatures, not responding servers,
+            # or an unresolvable (Byzantine) share would stop the
+            # fan-out early and starve verification below quorum.
+            try:
+                share = pkt.parse_signature(payload)
+                if share is not None and share.cert:
+                    for c in certmod.parse(share.cert):
+                        if self.crypt.keyring.get(c.id) is None:
+                            extra_certs.setdefault(c.id, c)
+                added = False
+                for sid, sb in sigmod.parse_entries(
+                    share.data if share else None
                 ):
-                    verrs = self.crypt.collective.verify_many(
-                        jobs, qa, self.crypt.keyring
-                    )
-                for j, i in enumerate(jidx):
-                    if verrs[j] is not None:
-                        results[i] = verrs[j]
+                    if (
+                        self.crypt.keyring.get(sid) is not None
+                        or sid in extra_certs
+                    ):
+                        entries[pending[k]].setdefault(sid, sb)
+                        added = True
+                return None if added else _SKIP
+            except Exception as e:
+                return e
 
-            # ---- phase 3: write (reference: client.go:94-121) --------
-            pending = [i for i in range(n) if results[i] is None]
-            if not pending:
-                return results
-            data = [
-                pkt.serialize(
-                    items[i][0], items[i][1], ts[i], sigs[i], sss[i]
-                )
-                for i in pending
+        with metrics.timer("client.write_many.phase_sign"), trace.span(
+            "phase.sign", attrs={"peers": len(qa.nodes())}
+        ):
+            # Staged fan-out, as in collect_signatures: a minimal
+            # sufficient prefix signs first; the remainder is asked
+            # only if some item is still short.
+            wave1, rest = _staged_wave(qa)
+            payload_bytes = pkt.serialize_list(reqs)
+            cb = _batch_cb(stally, len(pending), on_share)
+            self.tr.multicast(tp.BATCH_SIGN, wave1, payload_bytes, cb)
+            if rest and not all(stally.done):
+                metrics.incr("client.sign.fanout_expanded")
+                self.tr.multicast(tp.BATCH_SIGN, rest, payload_bytes, cb)
+        jobs: list[tuple[bytes, pkt.SignaturePacket]] = []
+        jidx: list[int] = []
+        sss: dict[int, pkt.SignaturePacket] = {}
+        for k, i in enumerate(pending):
+            err = stally.item_error(
+                k, ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES
+            )
+            if err is not None:
+                results[i] = err
+                continue
+            embeds = [
+                extra_certs[sid]
+                for sid in entries[i]
+                if sid in extra_certs
             ]
-            qw = self.qs.choose_quorum(qm.WRITE)
-            wtally = _BatchTally(len(pending), qw.is_threshold, qw.reject)
-            with metrics.timer("client.write_many.phase_write"), trace.span(
-                "phase.write", attrs={"peers": len(qw.nodes())}
+            ss = pkt.SignaturePacket(
+                type=pkt.SIGNATURE_TYPE_NATIVE,
+                version=1,
+                completed=True,
+                data=sigmod.serialize_entries(list(entries[i].items())),
+                cert=certmod.serialize_many(embeds) if embeds else None,
+            )
+            sss[i] = ss
+            tbss = pkt.serialize(
+                items[i][0], items[i][1], ts[i], sigs[i], nfields=4
+            )
+            jobs.append((tbss, ss))
+            jidx.append(i)
+        if jobs:
+            with metrics.timer(
+                "client.write_many.phase_verify"
+            ), trace.span(
+                "verify.collective", attrs={"batch_size": len(jobs)}
             ):
-                self.tr.multicast(
-                    tp.BATCH_WRITE,
-                    qw.nodes(),
-                    pkt.serialize_list(data),
-                    _batch_cb(wtally, len(pending), lambda k, payload: None),
+                verrs = self.crypt.collective.verify_many(
+                    jobs, qa, self.crypt.keyring
                 )
-            nok = 0
-            for k, i in enumerate(pending):
-                err = wtally.item_error(
-                    k, ERR_INSUFFICIENT_NUMBER_OF_RESPONSES
-                )
-                if err is not None:
-                    results[i] = err
-                else:
-                    nok += 1
-            metrics.incr("client.write.ok", nok)
-            return results
+            for j, i in enumerate(jidx):
+                if verrs[j] is not None:
+                    results[i] = verrs[j]
+
+        pending = [i for i in range(len(items)) if results[i] is None]
+        if not pending:
+            return None
+        return pending, ts, sigs, sss
+
+    def _wm_write(self, items, results, pending, ts, sigs, sss) -> None:
+        """Phase 3 of the batched write for one chunk
+        (reference: client.go:94-121)."""
+        data = [
+            pkt.serialize(
+                items[i][0], items[i][1], ts[i], sigs[i], sss[i]
+            )
+            for i in pending
+        ]
+        qw = self.qs.choose_quorum(qm.WRITE)
+        wtally = _BatchTally(len(pending), qw.is_threshold, qw.reject)
+        with metrics.timer("client.write_many.phase_write"), trace.span(
+            "phase.write", attrs={"peers": len(qw.nodes())}
+        ):
+            self.tr.multicast(
+                tp.BATCH_WRITE,
+                qw.nodes(),
+                pkt.serialize_list(data),
+                _batch_cb(wtally, len(pending), lambda k, payload: None),
+            )
+        nok = 0
+        for k, i in enumerate(pending):
+            err = wtally.item_error(
+                k, ERR_INSUFFICIENT_NUMBER_OF_RESPONSES
+            )
+            if err is not None:
+                results[i] = err
+            else:
+                nok += 1
+        metrics.incr("client.write.ok", nok)
 
     def read_many(
         self, variables: list[bytes], proof=None
@@ -937,6 +1073,7 @@ class Client(Protocol):
         if node is None:
             node = Ref(sid)
         self.self_node.revoke(node)
+        vcache.invalidate_signer(sid)
         metrics.incr("client.revocations")
 
     # -- TPA driver (reference: client.go:359-474) ------------------------

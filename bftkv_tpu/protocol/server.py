@@ -41,6 +41,7 @@ from bftkv_tpu import transport as tp
 from bftkv_tpu.crypto import auth as authmod
 from bftkv_tpu.crypto import cert as certmod
 from bftkv_tpu.crypto import signature as sigmod
+from bftkv_tpu.crypto import vcache
 from bftkv_tpu.errors import error_from_string
 from bftkv_tpu.faults import failpoint as fp
 from bftkv_tpu.errors import (
@@ -298,11 +299,14 @@ class Server(Protocol):
             if proof is None:
                 raise ERR_AUTHENTICATION_FAILURE
             try:
+                # TPA-protected record: the verify memo is never
+                # consulted for auth proofs (crypto/vcache.py).
                 self.crypt.collective.verify(
                     variable,
                     proof,
                     self.qs.choose_quorum(qm.AUTH),
                     self.crypt.keyring,
+                    use_cache=False,
                 )
             except Exception:
                 raise ERR_AUTHENTICATION_FAILURE from None
@@ -382,6 +386,7 @@ class Server(Protocol):
         claim arbitrary signer ids and mint a quorum certificate."""
         q = self.qs.choose_quorum(qm.AUTH | qm.CERT)
         cache = issuer.__dict__.setdefault("_qcert_ok", {})
+        tbs = None
         signer_nodes = []
         for sid, sig_bytes in list(issuer.signatures.items()):
             c = self.crypt.keyring.get(sid)
@@ -389,7 +394,18 @@ class Server(Protocol):
                 continue
             ok = cache.get((sid, sig_bytes))
             if ok is None:
-                ok = certmod.verify_detached(issuer.tbs(), sig_bytes, c)
+                if tbs is None:
+                    tbs = issuer.tbs()
+                # The process-wide verify memo spans cert *instances*
+                # (keyring copy vs transient _present clones), so a
+                # presented rich cert re-verifies each endorsement at
+                # most once per process, not once per clone.
+                if vcache.enabled() and vcache.get(c, tbs, sig_bytes):
+                    ok = True
+                else:
+                    ok = certmod.verify_detached(tbs, sig_bytes, c)
+                    if ok and vcache.enabled():
+                        vcache.put(c, tbs, sig_bytes)
                 cache[(sid, sig_bytes)] = ok
             if ok:
                 signer_nodes.append(c)
@@ -448,11 +464,13 @@ class Server(Protocol):
                 if ss is None:
                     raise ERR_AUTHENTICATION_FAILURE
                 try:
+                    # TPA-protected record: bypass the verify memo.
                     self.crypt.collective.verify(
                         variable,
                         ss,
                         self.qs.choose_quorum(qm.AUTH),
                         self.crypt.keyring,
+                        use_cache=False,
                     )
                 except Exception:
                     raise ERR_AUTHENTICATION_FAILURE from None
@@ -571,6 +589,7 @@ class Server(Protocol):
             if node is None:
                 node = Ref(sid)
             self.self_node.revoke(node)
+            vcache.invalidate_signer(sid)
             revoked = True
             metrics.incr("server.revocations")
         if revoked:
@@ -736,9 +755,14 @@ class Server(Protocol):
         tbs = pkt.tbs(req)
         sigmod.verify_with_certificate(tbs, sig, issuer)
 
-        # The proof: a collective signature over the uid variable.
+        # The proof: a collective signature over the uid variable —
+        # auth-proof shaped, so the verify memo is bypassed.
         self.crypt.collective.verify(
-            variable, ss, self.qs.choose_quorum(qm.AUTH), self.crypt.keyring
+            variable,
+            ss,
+            self.qs.choose_quorum(qm.AUTH),
+            self.crypt.keyring,
+            use_cache=False,
         )
 
         ret = None
@@ -785,6 +809,7 @@ class Server(Protocol):
         for n in nodes:
             if peer is not None and n.id == peer.id:
                 self.self_node.revoke(n)
+                vcache.invalidate_signer(n.id)
         return None
 
     def _notify(self, req: bytes, peer, sender) -> bytes | None:
@@ -896,6 +921,7 @@ class Server(Protocol):
         parsed: list[tuple | None] = [None] * n  # (p, issuer, tbs)
         vitems: list = []
         vidx: list[int] = []
+        vmeta: list[tuple] = []  # (issuer, tbs, sig_bytes) per vitem
 
         # Embedded certificates are FRAME-level: any item's embedded
         # cert resolves signers of every item in the batch, and each
@@ -955,8 +981,13 @@ class Server(Protocol):
                     raise ERR_INVALID_SIGNATURE
                 tbs = pkt.tbs(r)
                 parsed[i] = (p, issuer, r)
+                # Verify-memo prefilter: an exact-triple hit skips the
+                # device batch (a miss verifies below and memoizes).
+                if vcache.enabled() and vcache.get(issuer, tbs, sig_bytes):
+                    continue
                 vitems.append((tbs, sig_bytes, issuer.public_key))
                 vidx.append(i)
+                vmeta.append((issuer, tbs, sig_bytes))
             except Exception as e:
                 results[i] = (_errstr(e), b"")
 
@@ -976,6 +1007,9 @@ class Server(Protocol):
                 if not ok[j]:
                     results[i] = (_errstr(ERR_INVALID_SIGNATURE), b"")
                     parsed[i] = None
+                elif vcache.enabled():
+                    issuer_j, tbs_j, sig_j = vmeta[j]
+                    vcache.put(issuer_j, tbs_j, sig_j)
 
         # Quorum certificate, cached per issuer within the batch
         # (reference: server.go:211-214).

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import threading
+from collections import OrderedDict
 
 from bftkv_tpu.errors import ERR_NOT_FOUND
 from bftkv_tpu.faults import failpoint as fp
@@ -37,6 +38,25 @@ class PlainStorage:
             env = os.environ.get("BFTKV_PLAIN_FSYNC", "")
             fsync = env == "1"
         self.fsync = fsync
+        # stem -> max stored t.  ``read(variable, 0)`` used to list the
+        # WHOLE directory to find the latest version — O(total files)
+        # of GIL-dropping syscalls per read, quadratic over a write
+        # burst.  The index is rebuilt from one listing on first use
+        # (so a restart onto an existing store stays correct) and
+        # maintained by ``write``; the store is single-process by
+        # contract (the reference serializes it behind one mutex too),
+        # so no other writer can stale it.
+        self._latest: dict[str, int] | None = None
+        # Write-through record cache (the block-cache any storage
+        # engine keeps): the protocol re-reads a variable's latest
+        # record at every admission station, and on slow filesystems
+        # those opens dominated the whole write path.  Bounded LRU of
+        # (stem, t) -> bytes; entries are installed from durable state
+        # only (after the atomic rename), so the cache can never serve
+        # bytes a crash could lose that the file couldn't.
+        # BFTKV_PLAIN_CACHE sizes it (entries; 0 disables).
+        self._cache: "OrderedDict[tuple[str, int], bytes]" = OrderedDict()
+        self._cache_max = int(os.environ.get("BFTKV_PLAIN_CACHE", "1024") or 0)
         os.makedirs(path, exist_ok=True)
 
     def _prefix(self, variable: bytes) -> str:
@@ -48,43 +68,76 @@ class PlainStorage:
             return "h" + hashlib.sha256(variable).hexdigest()
         return variable.hex()
 
-    def _latest_t(self, variable: bytes) -> int | None:
-        prefix = self._prefix(variable) + "."
-        best: int | None = None
-        try:
-            names = os.listdir(self.path)
-        except FileNotFoundError:
-            return None
-        for name in names:
-            if not name.startswith(prefix):
-                continue
+    def _index_locked(self) -> dict[str, int]:
+        """The latest-version index; caller holds the lock."""
+        idx = self._latest
+        if idx is None:
+            idx = {}
             try:
-                t = int(name[len(prefix) :])
-            except ValueError:
-                continue
-            if best is None or t > best:
-                best = t
-        return best
+                names = os.listdir(self.path)
+            except FileNotFoundError:
+                names = []
+            for name in names:
+                stem, sep, suffix = name.rpartition(".")
+                if not sep:
+                    continue
+                try:
+                    t = int(suffix)
+                except ValueError:
+                    continue  # .tmp / .k sidecars
+                if t > idx.get(stem, -1):
+                    idx[stem] = t
+            self._latest = idx
+        return idx
+
+    def _latest_t(self, variable: bytes) -> int | None:
+        return self._index_locked().get(self._prefix(variable))
 
     def read(self, variable: bytes, t: int = 0) -> bytes:
+        # The lock covers only index/cache state; the file I/O itself
+        # runs outside it (data files are never deleted and renames are
+        # atomic, so a concurrent writer cannot tear a read — but a
+        # lock held across a ~10 ms open on a slow filesystem WOULD
+        # serialize every concurrent handler touching this store).
+        stem = self._prefix(variable)
         with self._lock:
             if t == 0:
                 latest = self._latest_t(variable)
                 if latest is None:
                     raise ERR_NOT_FOUND
                 t = latest
-            fn = os.path.join(self.path, f"{self._prefix(variable)}.{t}")
-            try:
-                with open(fn, "rb") as f:
-                    return f.read()
-            except FileNotFoundError:
-                raise ERR_NOT_FOUND from None
+            if self._cache_max:
+                data = self._cache.get((stem, t))
+                if data is not None:
+                    self._cache.move_to_end((stem, t))
+                    return data
+        fn = os.path.join(self.path, f"{stem}.{t}")
+        try:
+            with open(fn, "rb") as f:
+                data = f.read()
+        except FileNotFoundError:
+            raise ERR_NOT_FOUND from None
+        with self._lock:
+            self._cache_put_locked(stem, t, data)
+        return data
+
+    def _cache_put_locked(self, stem: str, t: int, data: bytes) -> None:
+        if not self._cache_max:
+            return
+        self._cache[(stem, t)] = data
+        self._cache.move_to_end((stem, t))
+        while len(self._cache) > self._cache_max:
+            self._cache.popitem(last=False)
 
     def _write_atomic(self, fn: str, data: bytes) -> None:
-        """temp + fsync(file) + rename + fsync(dir); caller holds the
-        lock.  After a crash at ANY point, readers see either the old
-        state or the complete new file — never a torn version."""
-        tmp = fn + ".tmp"
+        """temp + fsync(file) + rename + fsync(dir).  After a crash at
+        ANY point, readers see either the old state or the complete new
+        file — never a torn version.  The temp name is per-thread: I/O
+        runs outside the store lock, and two racing persists of one
+        ``(variable, t)`` (a late staged-sign tail vs the write phase)
+        must not interleave inside a shared temp file.  Non-integer
+        suffixes are invisible to every read/inventory path."""
+        tmp = f"{fn}.{threading.get_ident()}.tmp"
         with open(tmp, "wb") as f:
             f.write(data)
             if self.fsync:
@@ -99,31 +152,37 @@ class PlainStorage:
                 os.close(dfd)
 
     def write(self, variable: bytes, t: int, value: bytes) -> None:
+        # File I/O outside the lock (see read()): per-(variable, t) the
+        # rename is atomic and last-writer-wins, and the index/cache
+        # update re-takes the lock after the bytes are durable.
+        stem = self._prefix(variable)
+        if stem.startswith("h"):
+            # Hash-stemmed long variable: the name is one-way, so
+            # keys() needs a sidecar holding the raw bytes.  ".k"
+            # never parses as a version (int("k") fails) and the
+            # write is atomic like the data files'.
+            kf = os.path.join(self.path, stem + ".k")
+            if not os.path.exists(kf):
+                self._write_atomic(kf, variable)
+        fn = os.path.join(self.path, f"{stem}.{t}")
+        if fp.ARMED:
+            # ``storage.write`` failpoint: injected I/O error, or a
+            # torn write — half the bytes land in the .tmp and the
+            # "process" dies before rename (the crash the atomic
+            # protocol exists to survive).
+            act = fp.fire("storage.write", backend="plain", op="write")
+            if act is not None:
+                if act.kind == "torn":
+                    with open(fn + ".tmp", "wb") as f:
+                        f.write(value[: max(1, len(value) // 2)])
+                    raise OSError("injected torn write")
+                if act.kind == "io_error":
+                    raise OSError("injected storage I/O error")
+        self._write_atomic(fn, value)
         with self._lock:
-            stem = self._prefix(variable)
-            if stem.startswith("h"):
-                # Hash-stemmed long variable: the name is one-way, so
-                # keys() needs a sidecar holding the raw bytes.  ".k"
-                # never parses as a version (int("k") fails) and the
-                # write is atomic like the data files'.
-                kf = os.path.join(self.path, stem + ".k")
-                if not os.path.exists(kf):
-                    self._write_atomic(kf, variable)
-            fn = os.path.join(self.path, f"{stem}.{t}")
-            if fp.ARMED:
-                # ``storage.write`` failpoint: injected I/O error, or a
-                # torn write — half the bytes land in the .tmp and the
-                # "process" dies before rename (the crash the atomic
-                # protocol exists to survive).
-                act = fp.fire("storage.write", backend="plain", op="write")
-                if act is not None:
-                    if act.kind == "torn":
-                        with open(fn + ".tmp", "wb") as f:
-                            f.write(value[: max(1, len(value) // 2)])
-                        raise OSError("injected torn write")
-                    if act.kind == "io_error":
-                        raise OSError("injected storage I/O error")
-            self._write_atomic(fn, value)
+            if self._latest is not None and t > self._latest.get(stem, -1):
+                self._latest[stem] = t
+            self._cache_put_locked(stem, t, value)
 
     def versions(self, variable: bytes) -> list[int]:
         """All stored timestamps for ``variable`` (ascending)."""

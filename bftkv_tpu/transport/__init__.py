@@ -311,26 +311,43 @@ class MulticastResponse:
 
 
 class _DaemonPool:
-    """Reusable daemon-thread pool for the multicast fan-out.
+    """Bounded, reusable daemon-thread pool for the multicast fan-out.
 
     The reference spawns one goroutine per peer per multicast
     (transport.go:110-127), which is cheap in Go; a Python thread is
     not — a three-phase write over 64 replicas would create ~200
-    threads. This pool grows lazily, reuses idle workers, and differs
-    from ``concurrent.futures`` in two load-bearing ways: workers are
+    threads, and the old effectively-unbounded cap (4096) let every
+    burst turn into raw thread churn on a 2-CPU box.  This pool grows
+    lazily up to ``max_workers``, reuses idle workers, retires them
+    after ``idle_ttl`` down to a small floor, and differs from
+    ``concurrent.futures`` in two load-bearing ways: workers are
     *daemonic* (abandoned early-exit posts must not block interpreter
-    exit), and the cap is high enough (4096) that nested multicasts —
-    a loopback handler running on a pool worker and broadcasting NOTIFY
-    — cannot realistically starve into the circular-wait deadlock a
-    small bounded pool would allow.
+    exit), and a **nested** submit — a handler running ON a pool worker
+    fanning out again (loopback NOTIFY broadcast) — may spawn past the
+    cap.  Without that escape a full pool of workers each waiting on
+    its own nested fan-out is a circular-wait deadlock; with it the
+    overflow is bounded by the nesting degree, not the burst size.
+    ``transport.pool.saturated`` counts submits that had to queue
+    behind the cap.
     """
 
-    def __init__(self, max_workers: int = 4096):
-        self._q: "queue.Queue[Callable[[], None]]" = queue.Queue()
+    IDLE_TTL = 10.0
+    MIN_WORKERS = 4
+
+    def __init__(self, max_workers: int | None = None):
+        if max_workers is None:
+            max_workers = int(
+                os.environ.get("BFTKV_FANOUT_WORKERS", "256") or 256
+            )
+        # SimpleQueue: C-implemented put/get — the shared Condition
+        # machinery of queue.Queue was a measured lock convoy with ~100
+        # workers contending one mutex.
+        self._q: "queue.SimpleQueue[Callable[[], None]]" = queue.SimpleQueue()
         self._lock = threading.Lock()
         self._idle = 0
         self._count = 0
         self._max = max_workers
+        self._tls = threading.local()
 
     def submit(self, fn: Callable[[], None]) -> None:
         # Reserve a worker *at submit time*: either claim an idle one or
@@ -345,8 +362,15 @@ class _DaemonPool:
             elif self._count < self._max:
                 self._count += 1
                 spawn = True
+            elif getattr(self._tls, "in_worker", False):
+                # Nested fan-out from a saturated pool: spawning past
+                # the cap is the deadlock escape (see class doc).
+                self._count += 1
+                spawn = True
+                metrics.incr("transport.pool.nested_overflow")
             else:
                 spawn = False  # cap: task waits for the next free worker
+                metrics.incr("transport.pool.saturated")
         self._q.put(fn)
         if spawn:
             threading.Thread(
@@ -354,8 +378,20 @@ class _DaemonPool:
             ).start()
 
     def _worker(self) -> None:
+        self._tls.in_worker = True
         while True:
-            fn = self._q.get()
+            try:
+                fn = self._q.get(timeout=self.IDLE_TTL)
+            except queue.Empty:
+                # Idle past the TTL: retire down to the floor.  A claim
+                # racing this timeout decremented _idle already, so the
+                # guard also guarantees the claimed task keeps a worker.
+                with self._lock:
+                    if self._idle > 0 and self._count > self.MIN_WORKERS:
+                        self._idle -= 1
+                        self._count -= 1
+                        return
+                continue
             try:
                 fn()
             except Exception:  # workers must survive any task error
@@ -423,20 +459,54 @@ def multicast(
     # spans parent to the caller's phase span; the per-peer rpc spans
     # below are its siblings.
     ctx = trace.capture()
-    ch: "queue.Queue[MulticastResponse]" = queue.Queue()
+    ch: "queue.SimpleQueue[MulticastResponse]" = queue.SimpleQueue()
     cipher = None
     nonce = None
     payload = None
     launched = 0
-    for i, peer in enumerate(peers):
-        if i < len(mdata):
+    # Single-payload mode seals the shared plaintext ONCE per *session
+    # group* instead of per peer: recipients holding a pairwise session
+    # share one session envelope; the cold remainder shares one
+    # bootstrap envelope (MessageSecurity.encrypt_grouped).  Without
+    # the split, one sessionless peer in the set degraded every round
+    # to a full per-recipient bootstrap re-encryption.
+    grouped: list | None = None
+    if len(mdata) == 1 and len(peers) > 1:
+        sec = getattr(tr, "security", None)
+        msg_sec = getattr(sec, "message", None)
+        if msg_sec is not None and hasattr(msg_sec, "encrypt_grouped"):
             nonce = tr.generate_random()
-            payload = mdata[i] or b""
+            payload = mdata[0] or b""
             if ctx is not None:
                 payload = pkt.wrap_trace(ctx.trace_id, ctx.span_id, payload)
             try:
-                recipients = peers[i : i + len(peers) - len(mdata) + 1]
-                cipher = tr.encrypt(recipients, payload, nonce)
+                grouped = msg_sec.encrypt_grouped(peers, payload, nonce)
+            except Exception:
+                grouped = None  # fall back to the whole-set encrypt
+    if (
+        not fp.ARMED
+        and getattr(tr, "INLINE_FANOUT", False)
+        and _inline_fanout_ok()
+    ):
+        # In-process transport + calibrated all-host crypto: every post
+        # is GIL-bound Python, so the one-thread-per-peer fan-out only
+        # adds queue hand-offs and wake-up convoy — post inline on this
+        # thread, early-exiting at the callback's threshold; the
+        # remaining peers' posts ride ONE background task (delivery to
+        # the full set is unchanged, exactly like the threaded path's
+        # abandoned-but-completing workers).  The failpoint plane keeps
+        # the threaded path: chaos delays must stack per-link, not
+        # serialize through the caller.
+        _multicast_inline(
+            tr, name, peers, mdata, cb, ctx, grouped, nonce, payload, ch
+        )
+        return
+    for i, peer in enumerate(peers):
+        if grouped is not None:
+            cipher = grouped[i]
+        elif i < len(mdata):
+            try:
+                cipher, nonce, payload = _seal_one(tr, peers, mdata, i, ctx)
             except Exception as e:
                 ch.put(MulticastResponse(peer, None, e))
                 launched += 1
@@ -466,6 +536,110 @@ def multicast(
         mr = ch.get()
         if cb is not None and cb(mr):
             break  # early exit; remaining posts finish in their threads
+
+
+def _seal_one(tr, peers: list, mdata: list, i: int, ctx):
+    """Seal ``mdata``'s payload for the ``i``-th peer: fresh nonce,
+    trace-wrap, and the single-payload-mode recipients slice (one
+    element in ``mdata`` = encrypt once to the whole remaining set).
+    Shared by the threaded loop, the inline loop, and the inline tail —
+    raising on encrypt failure; callers own the error policy.
+    Returns ``(cipher, nonce, payload)``."""
+    nonce = tr.generate_random()
+    payload = mdata[i] or b""
+    if ctx is not None:
+        payload = pkt.wrap_trace(ctx.trace_id, ctx.span_id, payload)
+    recipients = peers[i : i + len(peers) - len(mdata) + 1]
+    return tr.encrypt(recipients, payload, nonce), nonce, payload
+
+
+def _inline_fanout_ok() -> bool:
+    """Inline fan-out engages only when every installed dispatcher
+    prefers host (calibration said the backend is all-host — CPU): on a
+    real accelerator the threaded fan-out is what lets concurrent
+    handlers' crypto coalesce into shared device launches."""
+    if _INLINE_FANOUT == "0":
+        return False
+    if _INLINE_FANOUT == "1":
+        return True
+    from bftkv_tpu.ops import dispatch
+
+    for d in (dispatch.get(), dispatch.get_signer()):
+        if d is not None and not d.prefer_host(1):
+            return False
+    return True
+
+
+_INLINE_FANOUT = os.environ.get("BFTKV_INLINE_FANOUT", "auto")
+
+
+def _multicast_inline(
+    tr, name, peers, mdata, cb, ctx, grouped, nonce, payload, ch
+) -> None:
+    """Sequential fan-out on the caller thread (see the call site).
+
+    Single-payload mode uses the grouped ciphers (or one whole-set
+    encrypt); per-peer mode encrypts as it goes.  After the callback
+    stops the fan-in, the unsent remainder is posted by one pool task —
+    responses discarded, exactly as the threaded path discards
+    responses that arrive after an early exit."""
+    cipher = None
+    stop_at = len(peers)
+    for i, peer in enumerate(peers):
+        if grouped is not None:
+            cipher = grouped[i]
+        elif i < len(mdata):
+            try:
+                cipher, nonce, payload = _seal_one(tr, peers, mdata, i, ctx)
+            except Exception as e:
+                if cb is not None and cb(MulticastResponse(peer, None, e)):
+                    stop_at = i + 1
+                    break
+                continue
+        addr = getattr(peer, "address", "")
+        if not addr:
+            mr = MulticastResponse(peer, None, ERR_NO_ADDRESS())
+        else:
+            with trace.span(
+                f"rpc.{name}",
+                attrs={"peer": getattr(peer, "name", "") or addr},
+            ):
+                _post_one(tr, name, peer, addr, cipher, nonce, payload, ch)
+            mr = ch.get()
+        if cb is not None and cb(mr):
+            stop_at = i + 1
+            break
+    if stop_at >= len(peers):
+        return
+    rest = list(
+        zip(
+            range(stop_at, len(peers)),
+            peers[stop_at:],
+        )
+    )
+
+    def post_tail():
+        tail_ch: "queue.SimpleQueue" = queue.SimpleQueue()
+        t_nonce, t_payload, t_cipher = nonce, payload, cipher
+        with trace.attach(ctx):
+            for j, peer in rest:
+                if grouped is not None:
+                    t_cipher = grouped[j]
+                elif j < len(mdata):
+                    try:
+                        t_cipher, t_nonce, t_payload = _seal_one(
+                            tr, peers, mdata, j, ctx
+                        )
+                    except Exception:
+                        continue
+                addr = getattr(peer, "address", "")
+                if addr:
+                    _post_one(
+                        tr, name, peer, addr, t_cipher, t_nonce, t_payload,
+                        tail_ch,
+                    )
+
+    _pool.submit(post_tail)
 
 
 def _inject_send_fault(tr, url, data, name, addr):

@@ -12,14 +12,15 @@ concurrency model (many servers run in one test process).
 
 from __future__ import annotations
 
+import http.client
 import socket
 import threading
-import urllib.error
-import urllib.request
+import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from bftkv_tpu import transport as tp
 from bftkv_tpu.errors import Error, error_from_string
+from bftkv_tpu.metrics import registry as metrics
 
 __all__ = ["TrHTTP", "MalTrHTTP", "default_rpc_timeout"]
 
@@ -52,6 +53,10 @@ def _is_timeout(e: Exception) -> bool:
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    #: Socket timeout for one keep-alive connection's next request:
+    #: clients pool persistent connections now, and an idle connection
+    #: must release its server thread instead of parking it forever.
+    timeout = 60.0
 
     def log_message(self, fmt, *args):  # quiet; observability lives upstream
         pass
@@ -93,6 +98,75 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(res)
 
 
+class _ConnPool:
+    """Bounded per-peer pool of keep-alive ``HTTPConnection`` objects.
+
+    The old client opened a fresh TCP connection per RPC
+    (``urllib.request.urlopen``) — three-way handshake plus slow-start
+    on every one of a write's ~12 posts.  Connections returned here are
+    reused across RPCs (``transport.conn.reused``), dialed on demand
+    (``transport.conn.dialed``), and capped at ``per_peer`` idle
+    connections per (host, port) so a wide fan-out cannot accumulate
+    sockets without bound."""
+
+    def __init__(self, per_peer: int | None = None):
+        if per_peer is None:
+            per_peer = int(os.environ.get("BFTKV_HTTP_POOL", "4") or 4)
+        self.per_peer = per_peer
+        self._lock = threading.Lock()
+        self._idle: dict[tuple[str, int], list[http.client.HTTPConnection]] = {}
+        self._closed = False
+
+    def acquire(
+        self, host: str, port: int, timeout: float
+    ) -> tuple[http.client.HTTPConnection, bool]:
+        """(connection, was_reused).  A reused connection's socket
+        deadline is refreshed to this RPC's timeout."""
+        key = (host, port)
+        with self._lock:
+            idle = self._idle.get(key)
+            conn = idle.pop() if idle else None
+        if conn is not None:
+            conn.timeout = timeout
+            if conn.sock is None:
+                conn = None  # closed under us: dial honestly instead
+            else:
+                try:
+                    conn.sock.settimeout(timeout)
+                except OSError:
+                    conn = None
+            if conn is not None:
+                metrics.incr("transport.conn.reused")
+                return conn, True
+        conn = http.client.HTTPConnection(host, port, timeout=timeout)
+        conn.connect()
+        metrics.incr("transport.conn.dialed")
+        return conn, False
+
+    def release(self, host: str, port: int, conn) -> None:
+        with self._lock:
+            if not self._closed:
+                idle = self._idle.setdefault((host, port), [])
+                if len(idle) < self.per_peer:
+                    idle.append(conn)
+                    return
+        try:
+            conn.close()
+        except Exception:
+            pass
+
+    def close_all(self) -> None:
+        with self._lock:
+            conns = [c for idle in self._idle.values() for c in idle]
+            self._idle.clear()
+            self._closed = True
+        for c in conns:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+
 class TrHTTP:
     """(reference: http.go:21-95)."""
 
@@ -106,33 +180,82 @@ class TrHTTP:
         self.link_id = ""  # set on start(); clients keep ""
         self._server: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
+        self._pool = _ConnPool()
 
     # -- client side ------------------------------------------------------
     def post(self, addr: str, msg: bytes) -> bytes:
-        req = urllib.request.Request(
-            addr,
-            data=msg or b"",
-            headers={"content-type": "application/octet-stream"},
-            method="POST",
-        )
+        """One RPC over a pooled keep-alive connection.
+
+        A *reused* connection that dies before any response byte
+        arrives (the server closed it while idle — the classic
+        keep-alive race) is re-dialed once, transparently; the retry
+        honors the same per-RPC deadline and is invisible to the
+        circuit-breaker/retry layer above (``transport._send``), which
+        only ever sees one logical attempt."""
+        parts = urllib.parse.urlsplit(addr)
+        host = parts.hostname or ""
+        port = parts.port or 80
+        path = parts.path
         cmd_name = addr.rsplit("/", 1)[-1]
-        try:
-            with urllib.request.urlopen(req, timeout=self.rpc_timeout) as res:
-                body = res.read()
-            tp.record_rpc("http", "client", cmd_name, len(body), len(msg or b""))
-            return body
-        except urllib.error.HTTPError as e:
-            errs = e.headers.get("x-error") if e.headers else None
-            e.close()
-            if e.code == 500 and errs:
-                raise error_from_string(errs) from None
-            raise tp.ERR_SERVER_ERROR from None
-        except Error:
-            raise
-        except Exception as e:
-            if _is_timeout(e):
-                raise tp.ERR_RPC_TIMEOUT from None
-            raise tp.ERR_SERVER_ERROR from None
+        body = msg or b""
+        while True:
+            try:
+                conn, reused = self._pool.acquire(host, port, self.rpc_timeout)
+            except Exception as e:
+                if _is_timeout(e):
+                    raise tp.ERR_RPC_TIMEOUT from None
+                raise tp.ERR_SERVER_ERROR from None
+            try:
+                try:
+                    conn.request(
+                        "POST",
+                        path,
+                        body=body,
+                        headers={"content-type": "application/octet-stream"},
+                    )
+                    res = conn.getresponse()
+                except (
+                    http.client.RemoteDisconnected,
+                    BrokenPipeError,
+                    ConnectionResetError,
+                ):
+                    conn.close()
+                    if reused:
+                        # Stale pooled connection (the server closed it
+                        # while idle): discard and retry transparently.
+                        # EVERY aged pooled connection may be stale at
+                        # once, so keep discarding until a fresh dial —
+                        # only a fresh connection failing this way is a
+                        # real server failure.  No response byte was
+                        # consumed, so the request cannot have been
+                        # half-served twice from this client's view.
+                        metrics.incr("transport.conn.redialed")
+                        continue
+                    raise tp.ERR_SERVER_ERROR from None
+                data = res.read()
+                keep = not res.will_close
+                errs = res.getheader("x-error")
+                status = res.status
+                if keep:
+                    self._pool.release(host, port, conn)
+                else:
+                    conn.close()
+                if status == 500 and errs:
+                    raise error_from_string(errs)
+                if status != 200:
+                    raise tp.ERR_SERVER_ERROR
+                tp.record_rpc("http", "client", cmd_name, len(data), len(body))
+                return data
+            except Error:
+                raise
+            except Exception as e:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+                if _is_timeout(e):
+                    raise tp.ERR_RPC_TIMEOUT from None
+                raise tp.ERR_SERVER_ERROR from None
 
     def multicast(self, cmd: int, peers: list, data: bytes | None, cb) -> None:
         tp.multicast(self, cmd, peers, [data], cb)
@@ -160,6 +283,7 @@ class TrHTTP:
         return tp.instrument_handler("http", o.handler)
 
     def stop(self) -> None:
+        self._pool.close_all()
         if self._server is not None:
             self._server.shutdown()
             self._server.server_close()

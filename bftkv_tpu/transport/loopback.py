@@ -35,6 +35,12 @@ class LoopbackNet:
 class TrLoopback:
     """Same interface as TrHTTP over a shared :class:`LoopbackNet`."""
 
+    #: Posts are synchronous in-process calls: when calibration says the
+    #: crypto is all-host anyway, the multicast fan-out runs inline on
+    #: the caller thread instead of spraying GIL-bound work across pool
+    #: threads (transport.multicast).
+    INLINE_FANOUT = True
+
     def __init__(
         self, security, net: LoopbackNet, *, rpc_timeout: float | None = None
     ):

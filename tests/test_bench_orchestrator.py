@@ -333,6 +333,46 @@ def test_headline_prefers_tpu_backed_section(bench, monkeypatch, capsys):
     assert detail["extra"]["cluster_4"]["writes_per_sec"] == 6.72
 
 
+def test_stale_cache_never_beats_fresh_measurement(bench, monkeypatch, capsys):
+    """A cached capture of OLDER code is never promoted over a freshly
+    measured section — even a CPU-fallback one (r05 regression: the
+    headline was a cached-stale rns_kernel while a live cluster_4
+    measurement sat in the same record)."""
+    monkeypatch.setenv("BENCH_CONFIGS", "rns,c4")
+    bench._save_partial(
+        {
+            "sections": {
+                "rns_kernel": {
+                    "backend": "tpu",
+                    "jax": "x",
+                    "devices": ["TPU_0"],
+                    "captured": "2026-07-31T03:49:29Z",
+                    "fast_mode": False,
+                    "code": "stale-fingerprint",  # predates HEAD
+                    "result": {"best_verifies_per_sec": 550684.8},
+                }
+            }
+        }
+    )
+    monkeypatch.setattr(bench, "_probe_backend", lambda t: False)
+    monkeypatch.setattr(
+        bench,
+        "_run_child",
+        lambda token, t, force_cpu: {
+            "section": bench.SECTION_NAMES[token],
+            "backend": "cpu",
+            "devices": ["CPU_0"],
+            "jax": "x",
+            "result": {"writes_per_sec": 6.72},
+        },
+    )
+    compact, detail = _run_main(bench, capsys)
+    assert detail["extra"]["rns_kernel"]["cached_stale_code"] is True
+    assert compact["extra"]["headline_from"] == "cluster_4"
+    assert compact["metric"] == "signed_writes_per_sec_4replica"
+    assert compact["value"] == 6.72
+
+
 def test_per_section_timeout_budgets(bench, monkeypatch, capsys):
     """Sections get sized timeouts (a hung kernel section must not burn
     a cluster-sized budget); BENCH_SECTION_TIMEOUT overrides."""

@@ -44,7 +44,9 @@ def test_dispatcher_verifies_correctly(keypair):
 def test_dispatcher_coalesces_across_threads(keypair):
     key, pub = keypair
     metrics.reset()
-    d = dispatch.VerifyDispatcher(max_batch=4096, max_wait=0.05).start()
+    d = dispatch.VerifyDispatcher(
+        max_batch=4096, max_wait=0.05, calibrate=False
+    ).start()
     results = {}
     try:
         def worker(i):
@@ -84,9 +86,14 @@ def test_install_routes_collective_verify(keypair):
     cs = CollectiveSignature()
     share = cs.sign(signer, b"payload")
     metrics.reset()
-    dispatch.install(dispatch.VerifyDispatcher(max_batch=8, max_wait=0.005))
+    dispatch.install(
+        dispatch.VerifyDispatcher(max_batch=8, max_wait=0.005, calibrate=False)
+    )
     try:
-        cs.verify(b"payload", share, _Q(), None)
+        # use_cache=False: the share was seeded into the verify memo
+        # at issue time, and a memo hit would (correctly) skip the
+        # dispatcher this test exists to observe.
+        cs.verify(b"payload", share, _Q(), None, use_cache=False)
         assert metrics.snapshot().get("dispatch.verifies", 0) >= 1
     finally:
         dispatch.uninstall()
@@ -171,7 +178,7 @@ def test_signer_issue_many_routes_ec_through_dispatcher():
     cert = certmod.make_ec_certificate(ec_key.public, name="ec-d", uid="ec-d")
     metrics.reset()
     dispatch.install_signer(
-        dispatch.SignDispatcher(max_batch=8, max_wait=0.005)
+        dispatch.SignDispatcher(max_batch=8, max_wait=0.005, calibrate=False)
     )
     try:
         pkts = Signer(ec_key, cert).issue_many([b"a", b"b"])
@@ -190,7 +197,9 @@ def test_pipelined_flushes_interleave_and_stay_correct(keypair):
     until flush 2 has entered _run_batch — if flushes were serial this
     would deadlock (and the waits would time out and fail)."""
     key, pub = keypair
-    d = dispatch.VerifyDispatcher(max_batch=8, max_wait=0.5, pipeline=2)
+    d = dispatch.VerifyDispatcher(
+        max_batch=8, max_wait=0.5, pipeline=2, calibrate=False
+    )
     inner = d._run_batch
     first_in = threading.Event()
     second_in = threading.Event()
@@ -268,7 +277,9 @@ def test_stop_drains_inflight_flushes(keypair):
 
 def test_pipeline_one_restores_serial_flushing(keypair):
     key, pub = keypair
-    d = dispatch.VerifyDispatcher(max_batch=4, max_wait=0.001, pipeline=1)
+    d = dispatch.VerifyDispatcher(
+        max_batch=4, max_wait=0.001, pipeline=1, calibrate=False
+    )
     peak, inflight = [], []
     gate = threading.Lock()
     inner = d._run_batch

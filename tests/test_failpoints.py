@@ -174,14 +174,20 @@ def test_corrupt_on_minority_link_is_masked(cluster):
 
 
 def test_drop_beyond_f_fails_write_cleanly(cluster):
-    """Dropping the links to TWO of four write replicas (> f = 1) must
-    fail the write with a protocol error, not hang or corrupt."""
+    """Dropping the links to THREE of four write replicas must fail the
+    write with a protocol error, not hang or corrupt.
+
+    Three, not two: the write-class clauses commit at f+1 = 2 acks, so
+    a 2-drop write can legitimately COMMIT on the two surviving
+    replicas — the old 2-drop version only failed while the instant
+    drop errors outraced the surviving replicas' handler work and
+    tripped the eager fail-fast, a race the hot-loop overhaul flipped."""
     cl = cluster.clients[0]
     fp.arm(22)
     fp.registry.add(
         "transport.send",
         "drop",
-        match={"dst": lambda d: d in ("rw03", "rw04"), "cmd": "write"},
+        match={"dst": lambda d: d in ("rw02", "rw03", "rw04"), "cmd": "write"},
         rule_id="d2",
     )
     with pytest.raises(Error):

@@ -55,12 +55,22 @@ def test_http_error_tunnel(http_cluster):
     assert errors.error_from_string(ei.value.message) is type(ei.value)
 
 
-def test_http_concurrent_writes_share_device_batches(http_cluster):
+def test_http_concurrent_writes_share_device_batches(http_cluster, monkeypatch):
     """N clients writing concurrently through real sockets: all writes
     land, and the dispatcher coalesces verify calls from concurrent
-    handler threads into shared launches (mean batch > 1)."""
+    handler threads into shared launches (mean batch > 1).
+
+    Calibration and the verify memo are disabled for the duration:
+    both would (correctly) keep verifies away from the dispatcher on a
+    CPU backend, and this test exists to observe the coalescing
+    machinery itself."""
+    from bftkv_tpu.crypto import vcache
+
+    monkeypatch.setattr(vcache, "_ENABLED", False)
     metrics.reset()
-    d = dispatch.install(dispatch.VerifyDispatcher(max_batch=256, max_wait=0.01))
+    d = dispatch.install(
+        dispatch.VerifyDispatcher(max_batch=256, max_wait=0.01, calibrate=False)
+    )
     try:
         errors: list = []
 
@@ -89,6 +99,23 @@ def test_http_concurrent_writes_share_device_batches(http_cluster):
         assert mean > 1.0, f"no cross-request coalescing observed: {snap}"
     finally:
         dispatch.uninstall()
+
+
+def test_http_connections_are_reused(http_cluster):
+    """The per-peer keep-alive pool carries repeat RPCs on existing
+    sockets: after a warm first write, further writes mostly reuse
+    (transport.conn.reused grows much faster than .dialed)."""
+    c = http_cluster.clients[0]
+    c.write(b"http/pool-warm", b"w")  # dials + pools the quorum links
+    metrics.reset()
+    for i in range(3):
+        c.write(b"http/pool/%d" % i, b"v%d" % i)
+    snap = metrics.snapshot()
+    reused = snap.get("transport.conn.reused", 0)
+    dialed = snap.get("transport.conn.dialed", 0)
+    assert reused > 0, f"no connection reuse observed: {snap}"
+    # A write is ~12 RPCs; with warm pools nearly all should reuse.
+    assert reused >= 3 * dialed, (reused, dialed)
 
 
 def test_http_transport_is_really_used(http_cluster):

@@ -93,14 +93,17 @@ def test_hostile_grant_cannot_hijack_session(idents):
     a, v, m = (mk(i) for i in idents)
     v.decrypt(a.encrypt([idents[1].cert], b"x", b"n"))  # honest A->V session
     sid = next(iter(a._by_peer.values())).sid
-    # M forges a bootstrap to V whose grant reuses A's sid.
-    import os as _os
+    # M forges a bootstrap to V whose grant reuses A's sid.  Envelope
+    # secrets come from the crypto.rng DRBG seam now, so that is what
+    # gets forced.
     from unittest import mock
 
-    real = _os.urandom  # bind the real function before patching
+    from bftkv_tpu.crypto import rng as _rng
+
+    real = _rng.generate_random  # bind the real function before patching
 
     with mock.patch(
-        "bftkv_tpu.crypto.message.os.urandom",
+        "bftkv_tpu.crypto.message.rng.generate_random",
         side_effect=lambda n: sid if n == 16 else real(n),
     ):
         # Force M's grant sid to collide with A's.

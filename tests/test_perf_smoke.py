@@ -1,0 +1,84 @@
+"""Tier-1 perf smoke: a loopback 4-replica mini-bench with a floor.
+
+Transport/protocol throughput regressions (a dispatcher stall, a
+serialized fan-out, a storage path gone quadratic, a cache that stopped
+hitting) used to surface only in the next round's BENCH record; this
+asserts a CONSERVATIVE writes/sec floor in CI instead.  Loopback, not
+HTTP — per the port-block constraint, concurrent HTTP clusters in one
+test process collide (tests/cluster_utils port ranges are per-process).
+
+The floor is ~4x below the worst rate observed on the slowest
+known-good box (a time-sliced 2-vCPU container measured 8-17 writes/s
+at this shape), so it trips on structural regressions, not on CI
+noise.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import pytest
+
+from bftkv_tpu.ops import dispatch
+from bftkv_tpu.storage.memkv import MemStorage
+from tests.cluster_utils import start_cluster
+
+#: Conservative: a structural regression (serialized rounds, stalled
+#: dispatcher, quadratic storage) lands well below this; a loaded CI
+#: box does not.
+FLOOR_WRITES_PER_SEC = 2.0
+
+WRITERS = 4
+WRITES_PER_WRITER = 4
+KEY_BITS = 1024  # keygen speed; the write path is bits-agnostic
+
+
+def test_write_path_throughput_floor():
+    cluster = start_cluster(
+        4, WRITERS, 4, bits=KEY_BITS, storage_factory=MemStorage
+    )
+    clients = cluster.clients
+    try:
+        dispatch.install(dispatch.VerifyDispatcher(max_batch=256))
+        dispatch.install_signer(dispatch.SignDispatcher(max_batch=128))
+        value = os.urandom(1024)
+        # Session + compile warmup outside the timed region, exactly
+        # like bench.py's cluster sections.
+        for ci, c in enumerate(clients[:WRITERS]):
+            c.write(b"smoke/warm/%d" % ci, value)
+
+        errors: list = []
+
+        def run(ci: int, client) -> None:
+            try:
+                for i in range(WRITES_PER_WRITER):
+                    client.write(b"smoke/%d/%d" % (ci, i), value)
+            except Exception as e:  # pragma: no cover - surfaced below
+                errors.append(e)
+
+        threads = [
+            threading.Thread(target=run, args=(ci, c), daemon=True)
+            for ci, c in enumerate(clients[:WRITERS])
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        assert not errors, errors[0]
+
+        total = WRITERS * WRITES_PER_WRITER
+        rate = total / elapsed
+        # Correctness before rate: a fast wrong answer is no smoke pass.
+        assert clients[0].read(b"smoke/0/%d" % (WRITES_PER_WRITER - 1)) == value
+        assert rate >= FLOOR_WRITES_PER_SEC, (
+            f"write path regressed: {rate:.2f} writes/s "
+            f"< floor {FLOOR_WRITES_PER_SEC} "
+            f"({total} writes in {elapsed:.1f}s)"
+        )
+    finally:
+        dispatch.uninstall_all()
+        cluster.stop()

@@ -228,14 +228,22 @@ def test_port_squatter_verdicts_rejected():
         lsock.close()
 
 
-def test_cluster_verifies_through_sidecar(sidecar):
+def test_cluster_verifies_through_sidecar(sidecar, monkeypatch):
     from tests.cluster_utils import start_cluster
 
+    from bftkv_tpu.crypto import vcache
+
+    # The verify memo would satisfy this in-process cluster's repeat
+    # verifies from cache; disable it so protocol verifies actually
+    # reach the remote sidecar this test observes.
+    monkeypatch.setattr(vcache, "_ENABLED", False)
     addr, srv = sidecar
     c = start_cluster(4, 1, 4)
     metrics.reset()
     dispatch.install(
-        dispatch.VerifyDispatcher(verifier=RemoteVerifierDomain(addr))
+        dispatch.VerifyDispatcher(
+            verifier=RemoteVerifierDomain(addr), calibrate=False
+        )
     )
     try:
         cl = c.clients[0]

@@ -9,6 +9,7 @@ achieve nothing beyond wasted bandwidth."""
 from __future__ import annotations
 
 import random
+import time
 
 import pytest
 
@@ -144,7 +145,16 @@ def test_convergence_after_missed_writes(cluster):
 
     victim.start()
     base = metrics.snapshot()
-    d = dispatch.install(dispatch.VerifyDispatcher(max_wait=0.001))
+    # The verify memo (crypto/vcache.py) would satisfy every pulled
+    # record from cache in this shared-process cluster; disable it so
+    # the device-batch admission path this test observes is exercised
+    # (a restarted replica PROCESS starts with an empty memo).
+    from bftkv_tpu.crypto import vcache as _vcache
+    _was = _vcache._ENABLED
+    _vcache._ENABLED = False
+    d = dispatch.install(
+        dispatch.VerifyDispatcher(max_wait=0.001, calibrate=False)
+    )
     try:
         daemon = SyncDaemon(victim, interval=999, rng=random.Random(1))
         stats = daemon.run_round()
@@ -169,6 +179,7 @@ def test_convergence_after_missed_writes(cluster):
             snap["sync.pull.records"] - base.get("sync.pull.records", 0) == M
         )
     finally:
+        _vcache._ENABLED = _was
         dispatch.uninstall()
 
     # Digest equality across every storage replica, reached with zero
@@ -332,9 +343,24 @@ def test_stale_replay_is_ignored_not_admitted(cluster):
     cl = c.clients[0]
     cl.write(b"stale-key", b"v1")
     victim = c.server_named("rw04")
-    old = latest_completed(victim.storage, b"stale-key")
+    # write() returns at the commit threshold; delivery to the full
+    # replica set completes asynchronously (the fan-out tail), so wait
+    # for rw04's copy instead of assuming synchronous full delivery.
+    old = None
+    for _ in range(200):
+        old = latest_completed(victim.storage, b"stale-key")
+        if old is not None:
+            break
+        time.sleep(0.01)
     assert old is not None
     cl.write(b"stale-key", b"v2")
+    # Same asynchrony for v2: the replayed v1 is only STALE once the
+    # victim's own copy has moved past it.
+    for _ in range(200):
+        cur = latest_completed(victim.storage, b"stale-key")
+        if cur is not None and pkt.parse(cur[1]).value == b"v2":
+            break
+        time.sleep(0.01)
     stats = admit_records(victim, [old[1]])
     assert stats["admitted"] == 0
     assert stats["rejected"] == 0

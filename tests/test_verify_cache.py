@@ -1,0 +1,194 @@
+"""Verified-signature memo (crypto/vcache.py): safety properties.
+
+The memo may only ever change WHERE a successful verification is
+computed, never WHAT verifies: the full key triple must byte-match
+(flipping any of signer key / tbs / sig misses), revocation evicts,
+negative results are never cached, TPA paths bypass it, and a warm
+cache must not let a tampered signature through.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from bftkv_tpu.crypto import cert as certmod
+from bftkv_tpu.crypto import rsa, vcache
+from bftkv_tpu.crypto.signature import (
+    CollectiveSignature,
+    Signer,
+    verify_with_certificate,
+)
+from bftkv_tpu.errors import ERR_INVALID_SIGNATURE
+from bftkv_tpu.metrics import registry as metrics
+
+KEY_BITS = 1024  # keygen speed; cache keys are digest-based either way
+
+
+@pytest.fixture(scope="module")
+def identity():
+    key = rsa.generate(KEY_BITS)
+    cert = certmod.Certificate(n=key.n, e=key.e, name="vc", uid="vc")
+    return key, cert
+
+
+@pytest.fixture(autouse=True)
+def fresh_cache():
+    vcache.reset()
+    metrics.reset()
+    yield
+    vcache.reset()
+    metrics.reset()
+
+
+class _Q:
+    def is_sufficient(self, nodes):
+        return len(nodes) >= 1
+
+
+def _share(key, cert, tbs: bytes):
+    return CollectiveSignature().sign(Signer(key, cert), tbs)
+
+
+def test_hit_requires_exact_triple(identity):
+    key, cert = identity
+    tbs = b"triple-match"
+    sig = rsa.sign(tbs, key)
+    vcache.put(cert, tbs, sig)
+    assert vcache.get(cert, tbs, sig)
+
+    # Flip one byte of the tbs -> miss.
+    assert not vcache.get(cert, b"Triple-match", sig)
+    # Flip one byte of the sig -> miss.
+    tampered = sig[:-1] + bytes([sig[-1] ^ 1])
+    assert not vcache.get(cert, tbs, tampered)
+    # Different signer key material (same everything else) -> miss.
+    other = rsa.generate(KEY_BITS)
+    other_cert = certmod.Certificate(n=other.n, e=other.e, name="o", uid="o")
+    assert not vcache.get(other_cert, tbs, sig)
+
+
+def test_same_id_different_key_material_misses(identity):
+    """The fingerprint binds the public key bytes, not just the id: a
+    forged cert claiming an honest id but different key material must
+    not share the honest signer's entries."""
+    key, cert = identity
+    tbs = b"id-collision"
+    sig = rsa.sign(tbs, key)
+    vcache.put(cert, tbs, sig)
+
+    other = rsa.generate(KEY_BITS)
+    forged = certmod.Certificate(n=other.n, e=other.e, name="f", uid="f")
+    forged.__dict__["_id"] = cert.id  # forced id collision
+    assert forged.id == cert.id
+    assert not vcache.get(forged, tbs, sig)
+
+
+def test_revocation_evicts(identity):
+    key, cert = identity
+    for i in range(3):
+        tbs = b"rev-%d" % i
+        vcache.put(cert, tbs, rsa.sign(tbs, key))
+    assert vcache.get(cert, b"rev-0", rsa.sign(b"rev-0", key))
+    vcache.invalidate_signer(cert.id)
+    assert len(vcache.cache) == 0
+    assert not vcache.get(cert, b"rev-1", rsa.sign(b"rev-1", key))
+
+
+def test_negative_results_never_cached(identity):
+    key, cert = identity
+    tbs = b"negative"
+    share = _share(key, cert, tbs)
+    good = share.data
+    # Tamper the signature bytes inside the entry encoding.
+    share.data = good[:-1] + bytes([good[-1] ^ 1])
+    before = len(vcache.cache)
+    with pytest.raises(ERR_INVALID_SIGNATURE):
+        verify_with_certificate(tbs, share, cert, use_cache=True)
+    assert len(vcache.cache) == before, "a failed verify was memoized"
+    # The honest bytes still verify (and only THEY get memoized).
+    share.data = good
+    verify_with_certificate(tbs, share, cert)
+
+
+def test_warm_cache_cannot_mask_tampering(identity):
+    """After a successful (memoized) verify, flipping any byte must
+    still be rejected — the memo key covers the full triple."""
+    key, cert = identity
+    tbs = b"no-masking"
+    share = _share(key, cert, tbs)
+    verify_with_certificate(tbs, share, cert)  # memoizes
+    good = share.data
+    share.data = good[:-1] + bytes([good[-1] ^ 1])
+    with pytest.raises(ERR_INVALID_SIGNATURE):
+        verify_with_certificate(tbs, share, cert)
+    share.data = good
+    with pytest.raises(ERR_INVALID_SIGNATURE):
+        verify_with_certificate(b"other-tbs", share, cert)
+
+
+def test_use_cache_false_bypasses_entirely(identity):
+    """The TPA paths pass use_cache=False: no consultation, no
+    insertion — the hit/miss series must stay silent."""
+    key, cert = identity
+    tbs = b"tpa-bypass"
+    share = _share(key, cert, tbs)
+    share_data_certless = share
+    vcache.reset()
+    metrics.reset()
+    cs = CollectiveSignature()
+
+    class Ring:
+        def get(self, sid):
+            return cert if sid == cert.id else None
+
+    cs.verify(tbs, share_data_certless, _Q(), Ring(), use_cache=False)
+    snap = metrics.snapshot()
+    assert snap.get("verify.cache.hits", 0) == 0
+    assert snap.get("verify.cache.misses", 0) == 0
+    assert len(vcache.cache) == 0
+
+
+def test_seeding_from_own_signature(identity):
+    """A signature issued by this process verifies from the memo
+    without recomputing the math (sign-then-verify correctness)."""
+    key, cert = identity
+    signer = Signer(key, cert)
+    pkt = signer.issue(b"seeded")
+    snap = metrics.snapshot()
+    assert snap.get("verify.cache.seeded", 0) >= 1
+
+    calls = []
+    orig = certmod.verify_detached
+
+    def counting(tbs, sig, c):
+        calls.append(tbs)
+        return orig(tbs, sig, c)
+
+    certmod.verify_detached = counting
+    try:
+        verify_with_certificate(b"seeded", pkt, cert)
+    finally:
+        certmod.verify_detached = orig
+    assert calls == [], "seeded verify recomputed the math"
+
+
+def test_collective_verify_memoizes_and_rechecks_quorum(identity):
+    """verify_many caches the math but recomputes sufficiency: the same
+    ss must fail against a stricter quorum even with a warm cache."""
+    key, cert = identity
+    tbs = b"quorum-recheck"
+    share = _share(key, cert, tbs)
+    cs = CollectiveSignature()
+
+    class Ring:
+        def get(self, sid):
+            return cert if sid == cert.id else None
+
+    cs.verify(tbs, share, _Q(), Ring())  # memoizes the entry
+
+    class Stricter:
+        def is_sufficient(self, nodes):
+            return len(nodes) >= 2
+
+    with pytest.raises(Exception):
+        cs.verify(tbs, share, Stricter(), Ring())
