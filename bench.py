@@ -28,6 +28,10 @@ north star. Everything else rides in ``extra``.
 Env knobs: BENCH_CONFIGS=kernel,c4,c16,c64,tally  BENCH_WRITERS=N
 BENCH_WRITES=N  BENCH_KERNEL_BATCHES=256,1024,4096  BENCH_FAST=1
 BENCH_BATCH=N (batched-pipeline sections)  BENCH_BACKEND_TIMEOUT=secs
+BENCH_ZIPF=S (or ``--zipf S``): zipf-skewed key popularity for the
+cluster sections — writers draw from one shared hot-key distribution
+(exponent S, e.g. 1.1) instead of disjoint uniform keys; same-key
+write races then surface as counted ``write_conflicts``, not errors.
 """
 
 from __future__ import annotations
@@ -516,6 +520,40 @@ def _make_cluster(
     return cluster.all_servers, cluster.clients
 
 
+def _zipf_probs(k: int, s: float) -> np.ndarray:
+    """Zipf(s) pmf over ranks 1..k (the workload-diversity knob:
+    ROADMAP item 5's hot-key shape)."""
+    ranks = np.arange(1, k + 1, dtype=np.float64)
+    p = ranks**-s
+    return p / p.sum()
+
+
+def _zipf_key(rng, ci: int, probs: np.ndarray) -> bytes:
+    """One zipf-skewed key from writer ``ci``'s slice (per-writer: a
+    writer identity OWNS a variable under TOFU, so the skew is in key
+    popularity, not cross-writer contention)."""
+    return b"bench/zipf/%d/%d" % (ci, int(rng.choice(len(probs), p=probs)))
+
+
+#: Errors that are EXPECTED when zipf-skewed writes race on a hot key
+#: (same timestamp picked twice, the quorum let exactly one through;
+#: in-flight overwrite colliding with read-repair).  Counted, not
+#: raised.  Keys are per-writer (one writer identity OWNS a variable
+#: under TOFU — cross-writer hot keys would measure TOFU rejections,
+#: not hot-key throughput), so the skew is in key popularity.
+def _is_write_conflict(e: Exception) -> bool:
+    from bftkv_tpu import errors as er
+
+    return e in (
+        er.ERR_INVALID_SIGN_REQUEST,
+        er.ERR_EQUIVOCATION,
+        er.ERR_BAD_TIMESTAMP,
+        er.ERR_INSUFFICIENT_NUMBER_OF_SIGNATURES,
+        er.ERR_INSUFFICIENT_NUMBER_OF_VALID_RESPONSES,
+        er.ERR_INSUFFICIENT_NUMBER_OF_RESPONSES,
+    )
+
+
 def bench_cluster(
     n_servers: int,
     n_rw: int,
@@ -528,9 +566,13 @@ def bench_cluster(
     read_fraction: float = 0.0,
     transport: str = "loop",
     alg: str = "rsa",
+    zipf: float = 0.0,
 ) -> dict:
     """Signed writes/sec (+ optional read mix) through a live in-process
-    cluster with the verify dispatcher installed."""
+    cluster with the verify dispatcher installed.  ``zipf > 0`` draws
+    keys from one shared Zipf(s) hot-key distribution instead of
+    per-writer disjoint keys (write races on a hot key are counted as
+    ``write_conflicts``)."""
     import tempfile
 
     from bftkv_tpu.metrics import registry as metrics
@@ -586,6 +628,12 @@ def bench_cluster(
 
         errors: list = []
         reads_by_thread = [0] * writers
+        conflicts_by_thread = [0] * writers
+        zipf_probs = (
+            _zipf_probs(max(writers * writes_per_writer, 16), zipf)
+            if zipf > 0
+            else None
+        )
 
         def run(ci: int, client) -> None:
             rng = np.random.default_rng(ci)
@@ -594,13 +642,40 @@ def bench_cluster(
                     read_fraction / (1 - read_fraction) if read_fraction else 0.0
                 )
                 for i in range(writes_per_writer):
-                    client.write(b"bench/%d/%d" % (ci, i), value)
+                    if zipf_probs is None:
+                        var = b"bench/%d/%d" % (ci, i)
+                    else:
+                        var = _zipf_key(rng, ci, zipf_probs)
+                    try:
+                        client.write(var, value)
+                    except Exception as e:
+                        if zipf_probs is None or not _is_write_conflict(e):
+                            raise
+                        conflicts_by_thread[ci] += 1
                     k = int(reads_per_write)
                     if rng.random() < reads_per_write - k:
                         k += 1
                     for _ in range(k):
-                        client.read(b"bench/%d/%d" % (ci, rng.integers(0, i + 1)))
-                        reads_by_thread[ci] += 1
+                        if zipf_probs is None:
+                            rv = b"bench/%d/%d" % (ci, rng.integers(0, i + 1))
+                        else:
+                            rv = _zipf_key(rng, ci, zipf_probs)
+                        try:
+                            client.read(rv)
+                        except Exception as e:
+                            # Zipf mode: a hot key racing its own
+                            # overwrite can fail transiently with an
+                            # interned protocol error; anything else
+                            # (and anything in uniform mode) is a real
+                            # failure.  Failed reads are NOT counted.
+                            from bftkv_tpu.errors import Error
+
+                            if zipf_probs is None or not isinstance(
+                                e, Error
+                            ):
+                                raise
+                        else:
+                            reads_by_thread[ci] += 1
             except Exception as e:  # surfaced below; bench must not hang
                 errors.append(e)
 
@@ -617,10 +692,16 @@ def bench_cluster(
         if errors:
             raise errors[0]
 
-        total_writes = writers * writes_per_writer
+        total_writes = writers * writes_per_writer - sum(conflicts_by_thread)
         total_reads = sum(reads_by_thread)
-        # Correctness spot check before reporting a rate.
-        got = clients[0].read(b"bench/0/%d" % (writes_per_writer - 1))
+        # Correctness spot check before reporting a rate.  Zipf runs
+        # use a fresh sentinel key — any hot key may have lost every
+        # race on this writer's attempts.
+        if zipf_probs is None:
+            got = clients[0].read(b"bench/0/%d" % (writes_per_writer - 1))
+        else:
+            clients[0].write(b"bench/zipf-check", value)
+            got = clients[0].read(b"bench/zipf-check")
         assert got == value, "read-back mismatch"
 
         snap = metrics.snapshot()
@@ -655,6 +736,9 @@ def bench_cluster(
             "rns_pallas": _pallas_status(),
             "setup_s": round(setup_s, 1),
         }
+        if zipf > 0:
+            res["zipf_s"] = zipf
+            res["write_conflicts"] = sum(conflicts_by_thread)
         res.update(_hot_loop_metrics(snap))
         return res
     finally:
@@ -814,6 +898,173 @@ def bench_cluster_batch(
             s.tr.stop()
 
 
+def bench_cluster_shards(
+    total_servers: int = 16,
+    total_rw: int = 16,
+    writers: int = 8,
+    writes_per_writer: int = 6,
+    shard_counts: tuple = (1, 2, 4),
+    *,
+    value_size: int = 512,
+    bits: int = 1024,
+    zipf: float = 0.0,
+) -> dict:
+    """Horizontal keyspace sharding proof (ROADMAP item 2): the SAME
+    replica budget (``total_servers`` quorum servers + ``total_rw``
+    storage nodes) and the SAME client count, re-partitioned into
+    1 / 2 / 4 hash-routed shards.  One 16-clique pays ~``suff(16)=11``
+    share signatures per write; four 4-cliques pay 3 and run
+    concurrently — writes/s should scale near-linearly while the
+    namespace stays one keyspace (uniform keys spread by
+    ``sha256(x) -> clique`` rendezvous routing; ``zipf > 0`` shows the
+    hot-key regime instead).  Reports per-shard route counters and the
+    bucket-assignment balance alongside each config's rate."""
+    from bftkv_tpu.metrics import registry as metrics
+    from bftkv_tpu.ops import dispatch
+    from bftkv_tpu.storage.memkv import MemStorage
+    from tests.cluster_utils import start_cluster
+
+    configs: list[dict] = []
+    for nsh in shard_counts:
+        if total_servers % nsh or total_rw % nsh:
+            raise ValueError("total replica counts must divide shard count")
+        t_setup = time.perf_counter()
+        cluster = start_cluster(
+            total_servers // nsh,
+            writers,
+            total_rw // nsh,
+            bits=bits,
+            storage_factory=MemStorage,
+            n_shards=nsh,
+        )
+        setup_s = time.perf_counter() - t_setup
+        servers, clients = cluster.all_servers, cluster.clients
+        try:
+            dispatch.install(dispatch.VerifyDispatcher(max_batch=256))
+            dispatch.install_signer(dispatch.SignDispatcher(max_batch=128))
+            value = os.urandom(value_size)
+            # Session + route-cache warmup: one write per (client,
+            # shard) so every client has live transport sessions to
+            # every clique before the timed region — the 1-shard config
+            # warms its whole fleet in one write, the sharded ones must
+            # not pay bootstrap envelopes mid-measurement.
+            shard_of = clients[0].qs.shard_of
+            for ci, c in enumerate(clients[:writers]):
+                seen: set = set()
+                k = 0
+                while len(seen) < nsh and k < 4096:
+                    key = b"bench/warm/%d/%d" % (ci, k)
+                    si = shard_of(key)
+                    if si not in seen:
+                        seen.add(si)
+                        c.write(key, value)
+                    k += 1
+            metrics.reset()
+
+            errors: list = []
+            conflicts = [0] * writers
+            zipf_probs = (
+                _zipf_probs(max(writers * writes_per_writer, 16), zipf)
+                if zipf > 0
+                else None
+            )
+
+            def run(ci: int, client) -> None:
+                rng = np.random.default_rng(1000 + ci)
+                try:
+                    for i in range(writes_per_writer):
+                        if zipf_probs is None:
+                            var = b"bench/%d/%d" % (ci, i)
+                        else:
+                            var = _zipf_key(rng, ci, zipf_probs)
+                        try:
+                            client.write(var, value)
+                        except Exception as e:
+                            if zipf_probs is None or not _is_write_conflict(e):
+                                raise
+                            conflicts[ci] += 1
+                except Exception as e:
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=run, args=(ci, c), daemon=True)
+                for ci, c in enumerate(clients[:writers])
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            writes_ok = writers * writes_per_writer - sum(conflicts)
+            got = clients[0].read(b"bench/0/%d" % (writes_per_writer - 1)
+                                  if zipf_probs is None else b"bench/warm/0/0")
+            assert got == value, "read-back mismatch"
+
+            snap = metrics.snapshot()
+            route_counts = {
+                k.split("shard=")[-1].rstrip("}"): v
+                for k, v in snap.items()
+                if k.startswith("quorum.route.shard{")
+            }
+            buckets = clients[0].qs.shard_buckets()
+            entry = {
+                "shards": nsh,
+                "servers_per_shard": total_servers // nsh,
+                "rw_per_shard": total_rw // nsh,
+                "replicas": total_servers + total_rw,
+                "writers": writers,
+                "writes": writes_ok,
+                "writes_per_sec": round(writes_ok / elapsed, 2),
+                "write_p50_s": round(
+                    snap.get("client.write.latency.p50", 0), 4
+                ),
+                "write_p99_s": round(
+                    snap.get("client.write.latency.p99", 0), 4
+                ),
+                "route_counts": route_counts,
+                "bucket_counts": buckets,
+                "bucket_balance_max_min": round(
+                    max(buckets) / max(min(buckets), 1), 3
+                ),
+                "quorum_cache_hits": snap.get("quorum.cache.hits", 0),
+                "quorum_cache_misses": snap.get("quorum.cache.misses", 0),
+                "setup_s": round(setup_s, 1),
+            }
+            if zipf > 0:
+                entry["zipf_s"] = zipf
+                entry["write_conflicts"] = sum(conflicts)
+            configs.append(entry)
+        finally:
+            dispatch.uninstall_all()
+            for s in servers:
+                s.tr.stop()
+
+    by_shards = {c["shards"]: c for c in configs}
+    base = by_shards.get(1, configs[0])
+    top = by_shards.get(max(by_shards), configs[-1])
+    out = {
+        "configs": configs,
+        "value_bytes": value_size,
+        "bits": bits,
+        # Headline for this section: the widest sharding's rate, with
+        # the scaling ratio against the single-quorum baseline.
+        "writes_per_sec": top["writes_per_sec"],
+        "scaling_vs_single_quorum": round(
+            top["writes_per_sec"] / max(base["writes_per_sec"], 1e-9), 2
+        ),
+        "linear_fraction": round(
+            top["writes_per_sec"]
+            / max(base["writes_per_sec"], 1e-9)
+            / max(top["shards"], 1),
+            3,
+        ),
+    }
+    return out
+
+
 def bench_threshold(rounds: int = 3) -> dict:
     """BASELINE config 3/4 signing: live (t,n)=(5,9) threshold CA over a
     9-replica cluster — RSA-2048 and ECDSA P-256 dist_sign rounds
@@ -967,13 +1218,16 @@ SECTION_NAMES = {
     "b64": "cluster_64_batched",
     "bmix64": "cluster_64_batched_mix",
     "bmix64ec": "cluster_64_batched_mix_ec",
+    "cshards": "cluster_shards",
     "thr": "threshold_5_9",
     "tally": "revoke_tally_256",
 }
 
 # Sections cheap enough to measure on CPU when the accelerator is
 # unreachable AND no cached TPU measurement exists (last resort).
-CPU_OK = {"tally", "c4"}
+# cluster_shards is a self-relative scaling ratio, meaningful on any
+# backend.
+CPU_OK = {"tally", "c4", "cshards"}
 
 # Per-section subprocess timeouts (seconds).  The flapping tunnel makes
 # a hung section indistinguishable from a slow one until the timeout
@@ -986,7 +1240,7 @@ TOKEN_TIMEOUT = {
     "rns": 900, "sign": 900, "ec": 900, "thr": 900,
     "c4": 900, "c4http": 900, "c4ec": 900, "c16": 900,
     "b16": 1200, "b64": 1500, "bmix64": 1500, "bmix64ec": 1500,
-    "c64": 1500, "mix64": 1500,
+    "c64": 1500, "mix64": 1500, "cshards": 1500,
 }
 
 # Headline preference: batched 64-replica pipeline first (the TPU-native
@@ -1014,6 +1268,7 @@ def _section_spec(token: str):
     writers = int(os.environ.get("BENCH_WRITERS", "4" if FAST else "16"))
     writes = int(os.environ.get("BENCH_WRITES", "4" if FAST else "16"))
     batch_size = int(os.environ.get("BENCH_BATCH", "256" if FAST else "1024"))
+    zipf = float(os.environ.get("BENCH_ZIPF", "0") or 0)
     specs = {
         "kernel": lambda: bench_kernel_verify(batches),
         "rns": lambda: bench_kernel_rns(
@@ -1030,30 +1285,39 @@ def _section_spec(token: str):
             (64,) if FAST else (256, 4096)
         ),
         "c4": lambda: bench_cluster(
-            4, 4, writers, writes, storage="plain", dispatch_batch=256
+            4, 4, writers, writes, storage="plain", dispatch_batch=256,
+            zipf=zipf,
         ),
         "c4http": lambda: bench_cluster(
             4, 4, writers, writes, storage="mem", dispatch_batch=256,
-            transport="http",
+            transport="http", zipf=zipf,
         ),
         # BASELINE config 4's key type: ECDSA P-256 identity certs.
         "c4ec": lambda: bench_cluster(
             4, 4, writers, writes, storage="mem", dispatch_batch=256,
-            alg="p256",
+            alg="p256", zipf=zipf,
         ),
         "c16": lambda: bench_cluster(
-            16, 4, writers, writes, storage="mem", dispatch_batch=256
+            16, 4, writers, writes, storage="mem", dispatch_batch=256,
+            zipf=zipf,
         ),
         # 8 rw storage nodes: with none, W = U - {Ci} + R is empty and
         # writes have nowhere to land (wotqs.go:72-115).
         "c64": lambda: bench_cluster(
             64, 8, writers, max(2, writes // 4), storage="mem",
-            dispatch_batch=1024,
+            dispatch_batch=1024, zipf=zipf,
         ),
         # BASELINE config 4: 64 replicas, 80/20 read/write mix.
         "mix64": lambda: bench_cluster(
             64, 8, writers, max(2, writes // 4), storage="mem",
-            dispatch_batch=1024, read_fraction=0.8,
+            dispatch_batch=1024, read_fraction=0.8, zipf=zipf,
+        ),
+        # ROADMAP item 2: same fleet + client count re-partitioned into
+        # 1/2/4 hash-routed shards; writes/s must scale near-linearly.
+        "cshards": lambda: bench_cluster_shards(
+            shard_counts=(1, 2) if FAST else (1, 2, 4),
+            writes_per_writer=3 if FAST else 6,
+            zipf=zipf,
         ),
         "b16": lambda: bench_cluster_batch(
             16, 4, 2 if FAST else 4, batch_size, 1 if FAST else 2
@@ -1201,7 +1465,7 @@ def main() -> None:
     use_cache = os.environ.get("BENCH_NO_CACHE") != "1"
 
     if FAST:
-        default_configs = "rns,sign,b16,kernel,modexp,ec,c4,c16,tally"
+        default_configs = "rns,sign,b16,kernel,modexp,ec,c4,c16,cshards,tally"
     else:
         # Short kernel sections FIRST: the tunnel flaps and its live
         # windows have been minutes long, so each window should bank
@@ -1211,7 +1475,7 @@ def main() -> None:
         # BENCH_partial.json keeps whatever landed.
         default_configs = (
             "rns,sign,kernel,ec,modexp,b16,b64,bmix64,bmix64ec,"
-            "c4,c16,c64,c4http,c4ec,thr,tally"
+            "c4,c16,c64,c4http,c4ec,cshards,thr,tally"
         )
     configs = [t for t in _env_list("BENCH_CONFIGS", default_configs)
                if t in SECTION_NAMES]
@@ -1458,6 +1722,12 @@ def _compact_extra(extra: dict, configs: list, headline_from) -> dict:
 
 
 if __name__ == "__main__":
+    # --zipf S: hot-key skew for the cluster sections, exported as
+    # BENCH_ZIPF so section subprocesses inherit it.
+    if "--zipf" in sys.argv:
+        i = sys.argv.index("--zipf")
+        os.environ["BENCH_ZIPF"] = sys.argv[i + 1]
+        del sys.argv[i : i + 2]
     if len(sys.argv) >= 5 and sys.argv[1] == "--run-section":
         _child_main(sys.argv[2], sys.argv[4])
     else:

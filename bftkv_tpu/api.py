@@ -63,7 +63,17 @@ class API:
 
     def register(self, cert_paths: list[str], password: str) -> None:
         """Join the network and get our certificate counter-signed by a
-        quorum (reference: api.go:74-147)."""
+        quorum (reference: api.go:74-147).
+
+        Sharded namespaces: enrollment is scoped to the clique owning
+        ``sha256(uid)`` — the TPA auth record for the uid lives only at
+        its owner shard (every other shard's admission rejects it), so
+        only that clique can verify the proof and counter-sign, and the
+        resulting quorum certificate is valid for variables that clique
+        owns.  Fleet-wide write access needs counter-signatures from
+        every clique, which is an operator action (``genkeys`` signs
+        generated users at every shard); per-shard runtime enrollment
+        for one uid is an open item."""
         self._sign_peers(cert_paths)
         self.client.joining()  # construct the full graph
         self._sign_peers(cert_paths)  # re-sign: joining may overwrite
@@ -76,7 +86,7 @@ class API:
         tbs = pkt.serialize(variable, cert_blob, t, nfields=3)
         sig = self.crypt.signer.issue(tbs)
         req = pkt.serialize(variable, cert_blob, t, sig, proof)
-        q = self.qs.choose_quorum(qm.AUTH | qm.PEER)
+        q = qm.choose_quorum_for(self.qs, variable, qm.AUTH | qm.PEER)
         signed: list[certmod.Certificate] = []
         succ: list = []
 

@@ -274,13 +274,30 @@ class _ApiService:
     """The daemon's own protocol client + graph introspection
     (reference: apiService, main.go:209-267)."""
 
-    def __init__(self, client, graph):
+    def __init__(self, client, graph, qs=None):
         self.client = client
         self.graph = graph
+        self.qs = qs  # the DAEMON's quorum system (not the client's)
 
     def show(self) -> str:
         g = self.graph
         lines = [f"self: {g.name} id={g.id:016x} addr={g.address} uid={g.uid}"]
+        qs = self.qs if self.qs is not None else getattr(
+            self.client, "qs", None
+        )
+        if qs is not None and hasattr(qs, "shard_count"):
+            try:
+                nsh = qs.shard_count()
+                if nsh > 1:
+                    owned = qs.owned_buckets()
+                    mine = qs.my_shard()
+                    lines.append(
+                        f"shards: {nsh} (mine={mine}, "
+                        f"owned_buckets="
+                        f"{'all' if owned is None else len(owned)}/256)"
+                    )
+            except Exception:
+                pass
         for peer in g.get_peers():
             lines.append(
                 f"peer: {peer.name} id={peer.id:016x} addr={peer.address} "
@@ -456,7 +473,7 @@ def main(argv: list[str] | None = None) -> int:
         api_httpd = ThreadingHTTPServer((host or "127.0.0.1", int(port)),
                                         _ApiHandler)
         api_httpd.daemon_threads = True
-        api_httpd.svc = _ApiService(client, graph)
+        api_httpd.svc = _ApiService(client, graph, qs)
         threading.Thread(target=api_httpd.serve_forever, daemon=True).start()
         print(f"bftkv: client API @ {args.api}", flush=True)
 

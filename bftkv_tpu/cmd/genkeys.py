@@ -18,8 +18,14 @@ import os
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", required=True, help="directory for the home dirs")
-    ap.add_argument("--servers", type=int, default=4)
-    ap.add_argument("--rw", type=int, default=4)
+    ap.add_argument("--servers", type=int, default=4,
+                    help="quorum servers per shard")
+    ap.add_argument("--rw", type=int, default=4,
+                    help="storage-only rw nodes per shard")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="number of disjoint server cliques: the "
+                         "keyspace hash-routes across them "
+                         "(--servers/--rw are per-shard counts)")
     ap.add_argument("--users", type=int, default=1)
     ap.add_argument("--unsigned-users", type=int, default=0,
                     help="trailing users without quorum certificates (TOFU)")
@@ -48,7 +54,14 @@ def main(argv: list[str] | None = None) -> int:
         unsigned_users=args.unsigned_users,
         server_trust_rw=args.server_trust_rw,
         alg=args.alg,
+        n_shards=args.shards,
     )
+    if args.shards > 1:
+        groups = ", ".join(
+            f"shard {i}: {g[0].name}..{g[-1].name}"
+            for i, g in enumerate(uni.shards)
+        )
+        print(f"{args.shards} quorum cliques ({groups})")
     os.makedirs(args.out, exist_ok=True)
     for ident in uni.all:
         home = os.path.join(args.out, ident.name)

@@ -25,6 +25,8 @@ import time
 
 def server_homes(keys_dir: str) -> list[str]:
     out = []
+    if not os.path.isdir(keys_dir):
+        return out  # --shards generates into a fresh dir
     for name in sorted(os.listdir(keys_dir)):
         home = os.path.join(keys_dir, name)
         if not os.path.isdir(home) or name.startswith("u"):
@@ -156,7 +158,25 @@ def main(argv: list[str] | None = None) -> int:
                          "failpoint registry (daemon i gets seed N+i); "
                          "same N replays the same fleet-wide fault "
                          "schedule (see bftkv --help)")
+    ap.add_argument("--shards", type=int, default=0, metavar="N",
+                    help="one-box sharded quickstart: when --keys holds "
+                         "no server homes yet, generate an N-clique "
+                         "topology there first (4 servers + 4 rw per "
+                         "shard, 1 user; the keyspace hash-routes "
+                         "across the cliques) and then run it")
     args = ap.parse_args(argv)
+
+    if args.shards and not server_homes(args.keys):
+        from bftkv_tpu.cmd import genkeys
+
+        print(
+            f"run_cluster: generating {args.shards}-shard topology "
+            f"under {args.keys}", flush=True,
+        )
+        genkeys.main([
+            "--out", args.keys, "--shards", str(args.shards),
+            "--servers", "4", "--rw", "4", "--users", "1",
+        ])
 
     homes = server_homes(args.keys)
     if not homes:

@@ -135,6 +135,12 @@ ERR_DECRYPTION_FAILURE = new_error("decryption failure")
 # used; the sender re-bootstraps on seeing this.
 ERR_UNKNOWN_SESSION = new_error("unknown transport session")
 
+# Keyspace sharding (this framework's addition, no reference analog):
+# the variable hash-routes to a quorum clique this replica is not a
+# member of — an honest client never sees this, a misrouted or
+# Byzantine request dies in admission.
+ERR_WRONG_SHARD = new_error("wrong shard")
+
 # Storage errors (reference: storage/storage.go).
 ERR_NOT_FOUND = new_error("not found")
 

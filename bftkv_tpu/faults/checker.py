@@ -185,11 +185,27 @@ class RecordingStorage:
 
 
 class SafetyChecker:
-    """Evaluates the four invariants over a recorded history."""
+    """Evaluates the safety invariants over a recorded history.
 
-    def __init__(self, recorder: HistoryRecorder, f: int):
+    ``shard_of_node`` (replica name -> shard index) activates the
+    cross-shard invariant for hash-routed sharded clusters; when
+    ``routing_stable`` also holds (the shard layout did not change
+    during the run — membership churn reroutes the keyspace, and
+    migration then LEGITIMATELY copies a variable between shards), the
+    strict form applies: a variable never commits certified values in
+    two different shards at all."""
+
+    def __init__(
+        self,
+        recorder: HistoryRecorder,
+        f: int,
+        shard_of_node: dict[str, int] | None = None,
+        routing_stable: bool = False,
+    ):
         self.recorder = recorder
         self.f = f
+        self.shard_of_node = shard_of_node
+        self.routing_stable = routing_stable
 
     def check(self, honest_servers: Iterable) -> list[str]:
         """Returns human-readable violations (empty = safe run).
@@ -201,6 +217,8 @@ class SafetyChecker:
         out += self._check_monotonic()
         out += self._check_read_integrity(servers)
         out += self._check_conflicting_commits()
+        if self.shard_of_node:
+            out += self._check_cross_shard()
         return out
 
     # -- 1. write-once immutability --------------------------------------
@@ -293,10 +311,14 @@ class SafetyChecker:
                 ):
                     continue
                 try:
+                    # Keyed: the signature must verify against the
+                    # quorum of the shard that OWNS the variable — a
+                    # value endorsed only by a foreign clique is not
+                    # backed.
                     srv.crypt.collective.verify(
                         pkt.tbss(raw),
                         p.ss,
-                        srv.qs.choose_quorum(qm.AUTH),
+                        qm.choose_quorum_for(srv.qs, variable, qm.AUTH),
                         srv.crypt.keyring,
                     )
                     return True
@@ -325,4 +347,57 @@ class SafetyChecker:
                     f"conflicting commits at ({var!r}, t={t}): "
                     f"{len(committed)} values each gathered {need}+ acks"
                 )
+        return out
+
+    # -- 5. cross-shard: one variable, one owner clique --------------------
+
+    def _check_cross_shard(self) -> list[str]:
+        """Sharding's new failure mode: shard B's replicas never run
+        shard A's equivocation checks, so a split-brain would show up as
+        certified state for one variable living in two shards.  Two
+        forms, by strength:
+
+        - always: no (variable, t) carries two DIFFERENT certified
+          values at honest replicas of two different shards — that is
+          cross-shard equivocation, impossible while routing holds (only
+          the owner clique will sign x, and every replica's admission
+          verifies the collective signature against the owner quorum);
+        - when ``routing_stable``: no variable has certified values in
+          two shards AT ALL — same-value copies across shards are
+          legitimate only as migration after a routing change, which a
+          stable run rules out."""
+        out = []
+        shard_of = self.shard_of_node or {}
+        # (variable, t) -> value -> shard set; variable -> shard set.
+        by_vt: dict[tuple[bytes, int], dict[bytes, set[int]]] = {}
+        by_var: dict[bytes, set[int]] = {}
+        for e in self.recorder.events("persist"):
+            if not e.fields.get("honest") or not e.fields.get("completed"):
+                continue
+            shard = shard_of.get(e.node)
+            if shard is None or e.value is None:
+                continue
+            by_vt.setdefault((e.variable, e.t), {}).setdefault(
+                e.value, set()
+            ).add(shard)
+            by_var.setdefault(e.variable, set()).add(shard)
+        for (var, t), by_value in by_vt.items():
+            if len(by_value) < 2:
+                continue
+            shard_sets = list(by_value.values())
+            spread = set().union(*shard_sets)
+            if len(spread) > 1:
+                out.append(
+                    f"cross-shard equivocation at ({var!r}, t={t}): "
+                    f"{len(by_value)} certified values across shards "
+                    f"{sorted(spread)}"
+                )
+        if self.routing_stable:
+            for var, shards in by_var.items():
+                if len(shards) > 1:
+                    out.append(
+                        f"variable {var!r} committed certified values in "
+                        f"{len(shards)} shards {sorted(shards)} with no "
+                        f"routing change to explain migration"
+                    )
         return out

@@ -47,20 +47,49 @@ class ChaosCluster:
     @property
     def f(self) -> int:
         """Fault bound of the replica group chaos targets (the storage
-        replicas when present, else the quorum servers)."""
+        replicas when present, else the quorum servers).  Sharded
+        clusters use the PER-SHARD group size: each shard tolerates its
+        own f, and the checker's commit threshold must match the quorum
+        a single shard actually forms."""
         n = len(self.storage_servers) or len(self.servers)
+        nsh = len(self.universe.shards)
+        if nsh > 1:
+            n = max(1, n // nsh)
         return (n - 1) // 3
 
     def server_named(self, name: str) -> Server:
         return self._by_name[name]
 
     def names(self, storage_only: bool = True) -> list[str]:
+        if len(self.universe.shards) > 1:
+            # Sharded cluster: chaos targets span BOTH planes of every
+            # shard — faults must be able to straddle shard boundaries.
+            return [
+                i.name
+                for i in self.universe.servers + self.universe.storage_nodes
+            ]
         idents = (
             self.universe.storage_nodes
             if storage_only and self.universe.storage_nodes
             else self.universe.servers
         )
         return [i.name for i in idents]
+
+    def shard_map(self) -> dict[str, int] | None:
+        """Replica name -> shard index (clique membership or storage
+        assignment), or None for unsharded clusters — the checker's
+        cross-shard invariant input."""
+        out: dict[str, int] = {}
+        sharded = False
+        for name, srv in self._by_name.items():
+            idx_of = getattr(srv.qs, "shard_index_of", None)
+            if idx_of is None:
+                continue
+            idx = idx_of(srv.self_node.get_self_id())
+            if idx is not None:
+                out[name] = idx
+                sharded = True
+        return out if sharded else None
 
     # -- crash / restart ---------------------------------------------------
 
@@ -103,9 +132,11 @@ def build_cluster(
     recorder: HistoryRecorder | None = None,
     server_cls=Server,
     storage_factory=MemStorage,
+    n_shards: int = 1,
 ) -> ChaosCluster:
     uni = topology.build_universe(
-        n_servers, n_users, n_rw, scheme="loop", bits=bits
+        n_servers, n_users, n_rw, scheme="loop", bits=bits,
+        n_shards=n_shards,
     )
     net = LoopbackNet()
     recorder = recorder or HistoryRecorder()

@@ -174,24 +174,43 @@ class Nemesis:
 
     def converge(self, max_rounds: int = 6) -> bool:
         """Drive anti-entropy rounds until every storage replica's
-        digest root agrees (bounded).  Returns True on convergence."""
+        digest root agrees (bounded).  Returns True on convergence.
+
+        Sharded clusters converge PER SHARD: replicas of different
+        shards hold disjoint keyspace slices by design, so roots are
+        compared within each shard group, never across."""
         from bftkv_tpu.sync import SyncDaemon
 
         replicas = self.cluster.storage_servers or self.cluster.servers
+
+        def group_of(s) -> object:
+            idx_of = getattr(s.qs, "shard_index_of", None)
+            if idx_of is None:
+                return "all"
+            idx = idx_of(s.self_node.get_self_id())
+            return "all" if idx is None else idx
+
+        def converged() -> bool:
+            roots: dict[object, set] = {}
+            for s in replicas:
+                roots.setdefault(group_of(s), set()).add(
+                    s._sync_tree().root()
+                )
+            return all(len(r) == 1 for r in roots.values())
+
         daemons = [
             SyncDaemon(s, interval=999, rng=random.Random(self.seed + i))
             for i, s in enumerate(replicas)
         ]
         for _ in range(max_rounds):
-            roots = {s._sync_tree().root() for s in replicas}
-            if len(roots) == 1:
+            if converged():
                 return True
             for d in daemons:
                 try:
                     d.run_round()
                 except Exception:
                     pass
-        return len({s._sync_tree().root() for s in replicas}) == 1
+        return converged()
 
     # -- one full run ------------------------------------------------------
 
@@ -245,6 +264,10 @@ class Nemesis:
         """Arm, execute the seeded plan with traffic, repair, check.
         Returns a report dict (``violations`` empty = safe run)."""
         plan = self.plan(steps)
+        # Shard layout before the run: if it survives unchanged (no
+        # membership churn rerouted the keyspace), the checker may apply
+        # the strict one-shard-per-variable invariant.
+        shard_map_before = self.cluster.shard_map()
         self.registry.arm(self.seed)
         try:
             cl = self._client(0)
@@ -265,11 +288,18 @@ class Nemesis:
             trace = self.registry.trace()
         finally:
             self.registry.disarm()
-        checker = SafetyChecker(self.cluster.recorder, f=self.cluster.f)
+        shard_map = self.cluster.shard_map()
+        checker = SafetyChecker(
+            self.cluster.recorder,
+            f=self.cluster.f,
+            shard_of_node=shard_map,
+            routing_stable=(shard_map == shard_map_before),
+        )
         replicas = self.cluster.storage_servers or self.cluster.servers
         violations = checker.check(replicas)
         return {
             "seed": self.seed,
+            "shards": len(set(shard_map.values())) if shard_map else 1,
             "plan": plan,
             "converged": converged,
             "faults_fired": len(trace),
@@ -288,8 +318,14 @@ def main(argv: list[str] | None = None) -> int:
     )
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--steps", type=int, default=4)
-    ap.add_argument("--servers", type=int, default=4)
-    ap.add_argument("--rw", type=int, default=4)
+    ap.add_argument("--servers", type=int, default=4,
+                    help="quorum servers per shard")
+    ap.add_argument("--rw", type=int, default=4,
+                    help="storage nodes per shard")
+    ap.add_argument("--shards", type=int, default=1,
+                    help="disjoint quorum cliques: faults then straddle "
+                         "shard boundaries and the checker enforces the "
+                         "cross-shard invariant")
     ap.add_argument("--bits", type=int, default=1024)
     ap.add_argument("--dwell", type=float, default=0.0,
                     help="extra seconds to hold each fault window open")
@@ -297,7 +333,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="print the full report as JSON")
     args = ap.parse_args(argv)
 
-    cluster = build_cluster(args.servers, 1, args.rw, bits=args.bits)
+    cluster = build_cluster(
+        args.servers, 1, args.rw, bits=args.bits, n_shards=args.shards
+    )
     try:
         report = Nemesis(cluster, seed=args.seed).run(
             steps=args.steps, dwell=args.dwell
@@ -308,7 +346,8 @@ def main(argv: list[str] | None = None) -> int:
         print(json.dumps(report, indent=2, default=repr))
         return 1 if report["violations"] or not report["converged"] else 0
     print(
-        f"nemesis seed={report['seed']} steps={len(report['plan'])} "
+        f"nemesis seed={report['seed']} shards={report['shards']} "
+        f"steps={len(report['plan'])} "
         f"faults_fired={report['faults_fired']} "
         f"failures={report['failures']} converged={report['converged']}"
     )

@@ -66,6 +66,12 @@ class Graph:
         # quorum survive a membership change.
         self.generation = 0
         self._gen_lock = threading.Lock()
+        # Operator-local trust edges (add_local_edges): present in
+        # ``Vertex.edges`` for quorum traversal but excluded from shard
+        # clique enumeration — they exist in THIS view only, and the
+        # routing table must be a function of certificate-borne edges
+        # every principal's view shares.
+        self._local_edge_pairs: set[tuple[int, int]] = set()
 
     def _bump_generation(self) -> None:
         with self._gen_lock:
@@ -93,6 +99,9 @@ class Graph:
                     v = Vertex(instance=None)  # placeholder
                     self.vertices[signer] = v
                 v.edges[skid] = self_v
+                # A certificate now backs this edge: it is no longer
+                # local-only (shard enumeration may use it).
+                self._local_edge_pairs.discard((signer, skid))
             res.append(n)
         return res
 
@@ -111,6 +120,9 @@ class Graph:
             for v in self.vertices.values():
                 v.edges.pop(nid, None)
             self.vertices.pop(nid, None)
+            self._local_edge_pairs = {
+                p for p in self._local_edge_pairs if nid not in p
+            }
             for i, sv in enumerate(self.self_vertices):
                 if sv.instance is not None and sv.instance.id == nid:
                     del self.self_vertices[i]
@@ -140,6 +152,11 @@ class Graph:
             v = self.vertices.get(sid)
             if v is None:
                 v = self.vertices[sid] = Vertex(instance=None)
+            if sid not in sv.edges:
+                # Only a genuinely NEW edge is local-only; an existing
+                # certificate-borne edge (every view has it) must keep
+                # counting for shard enumeration.
+                self._local_edge_pairs.add((signer_id, sid))
             sv.edges[sid] = v
 
     def get_peers(self) -> list:
@@ -306,6 +323,74 @@ class Graph:
                 )
                 return None
         return Clique(nodes=[c.instance for c in clique])
+
+    def get_disjoint_cliques(self, min_size: int = 4) -> list[Clique]:
+        """Disjoint-leaning maximal cliques over *addressed* vertices —
+        the shard universe (ROADMAP item 2, hash-routed quorums).
+
+        Unlike :meth:`get_cliques` this enumeration is global (not BFS
+        from a seed): a replica's own out-edges never reach another
+        shard's clique, yet its graph holds every certificate — and the
+        cross-signatures ride inside the certificates — so the
+        bidirectional edge set among addressed nodes is identical in
+        every principal's view.  Determinism matters more than clique
+        quality here (all views MUST route a key to the same clique):
+        seeds and growth both iterate in ascending node-id order, each
+        node joins at most one clique (``covered``), and unaddressed
+        principals (users) are excluded entirely so a user's mutual
+        edges with its certificate counter-signers cannot mint a bogus
+        shard.  Cliques below ``min_size`` (f < 1: no b-masking
+        parameters) are dropped — a single-clique graph therefore
+        yields at most one shard and keyed routing degenerates.
+        """
+        ids = sorted(
+            vid
+            for vid, v in self.vertices.items()
+            if v.instance is not None
+            and getattr(v.instance, "address", "")
+        )
+
+        def cert_edge(a_vid: int, b_vid: int) -> bool:
+            # Certificate-borne edge only: local-trust edges
+            # (add_local_edges) exist in this view alone and must not
+            # shape the shared routing table.
+            return (
+                b_vid in self.vertices[a_vid].edges
+                and (a_vid, b_vid) not in self._local_edge_pairs
+            )
+
+        covered: set[int] = set()
+        out: list[Clique] = []
+        for vid in ids:
+            if vid in covered:
+                continue
+            clique = [vid]
+            for wid in ids:
+                if wid == vid or wid in covered:
+                    continue
+                if all(
+                    cert_edge(wid, cid) and cert_edge(cid, wid)
+                    for cid in clique
+                ):
+                    clique.append(wid)
+            if len(clique) >= min_size:
+                out.append(
+                    Clique(
+                        nodes=[self.vertices[c].instance for c in clique]
+                    )
+                )
+                covered.update(clique)
+        return out
+
+    def weight_from(self, sid: int, nodes: list) -> int:
+        """Seed weight into a node set: the number of ``sid``'s
+        out-edges landing in ``nodes`` (the clique-weight rule of
+        :meth:`get_cliques`, graph.go:385-393, for cliques found by
+        global enumeration rather than BFS)."""
+        v = self.vertices.get(sid)
+        if v is None:
+            return 0
+        return sum(1 for n in nodes if n.id in v.edges)
 
     def get_in_reachable(self, destinations: list) -> list:
         res = []

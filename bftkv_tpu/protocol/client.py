@@ -218,7 +218,7 @@ class Client(Protocol):
             "client.write", attrs={"value_bytes": len(value)}
         ):
             with trace.span("quorum.select"):
-                qr = self.qs.choose_quorum(qm.READ | qm.AUTH)
+                qr = qm.choose_quorum_for(self.qs, variable, qm.READ | qm.AUTH)
             maxt = 0
             actives: list = []
             failure: list = []
@@ -253,7 +253,7 @@ class Client(Protocol):
     ) -> None:
         sig, ss = self.collect_signatures(variable, value, t, proof)
 
-        qw = self.qs.choose_quorum(qm.WRITE)
+        qw = qm.choose_quorum_for(self.qs, variable, qm.WRITE)
         data = pkt.serialize(variable, value, t, sig, ss)
         nodes: list = []
         failure: list = []
@@ -283,7 +283,7 @@ class Client(Protocol):
             sig = self.crypt.signer.issue(tbs)
             tbss = pkt.serialize(variable, value, t, sig, nfields=4)
 
-            qa = self.qs.choose_quorum(qm.AUTH | qm.PEER)
+            qa = qm.choose_quorum_for(self.qs, variable, qm.AUTH | qm.PEER)
             sp.attrs["peers"] = len(qa.nodes())
             # The client's auth proof rides in the ss slot of the request
             # (reference: client.go:142).
@@ -338,6 +338,27 @@ class Client(Protocol):
 
     # -- batched write pipeline (no reference analog) ---------------------
 
+    def _shard_groups(
+        self, variables: list[bytes]
+    ) -> list[tuple[int, list[int]]] | None:
+        """Partition a batch by owning shard.  Returns None when the
+        quorum system is unkeyed, the namespace is unsharded, or every
+        item already routes to one shard — the batch then runs exactly
+        as before.  Otherwise: (shard, item indices) groups in shard
+        order."""
+        shard_of = getattr(self.qs, "shard_of", None)
+        if shard_of is None:
+            return None
+        groups: dict[int | None, list[int]] = {}
+        for i, v in enumerate(variables):
+            groups.setdefault(shard_of(v), []).append(i)
+        if len(groups) <= 1:
+            return None
+        return sorted(
+            ((s, idx) for s, idx in groups.items()),
+            key=lambda t: (t[0] is None, t[0]),
+        )
+
     def write_many(
         self, items: list[tuple[bytes, bytes]], proof=None, *, window=None
     ) -> list[Exception | None]:
@@ -373,6 +394,22 @@ class Client(Protocol):
             # Duplicates in one batch would equivocate against each
             # other at the same timestamp; that is a caller bug.
             raise ValueError("write_many: duplicate variables in one batch")
+        groups = self._shard_groups(variables)
+        if groups is not None:
+            # Sharded namespace: each shard's items are one independent
+            # batch against that shard's quorums (all five phases of an
+            # item must agree on the clique).  Groups run sequentially
+            # on the caller thread; intra-group pipelining still
+            # overlaps the rounds that dominate.
+            metrics.incr("client.write_many.shard_split")
+            results: list[Exception | None] = [None] * len(items)
+            for _shard, idx in groups:
+                sub = self.write_many(
+                    [items[i] for i in idx], proof, window=window
+                )
+                for i, r in zip(idx, sub):
+                    results[i] = r
+            return results
         n = len(items)
 
         if window is None:
@@ -445,8 +482,12 @@ class Client(Protocol):
         item survived."""
         n = len(items)
         # ---- phase 1: timestamps (reference: client.go:62-92) ----
+        # Any item keys the quorum: write_many has already grouped the
+        # batch so every item routes to the same shard.
         with trace.span("quorum.select"):
-            qr = self.qs.choose_quorum(qm.READ | qm.AUTH)
+            qr = qm.choose_quorum_for(
+                self.qs, items[0][0], qm.READ | qm.AUTH
+            )
         maxts = [0] * n
         tally = _BatchTally(n, qr.is_threshold, qr.reject)
 
@@ -503,7 +544,7 @@ class Client(Protocol):
             for i in pending
         ]
 
-        qa = self.qs.choose_quorum(qm.AUTH | qm.PEER)
+        qa = qm.choose_quorum_for(self.qs, items[0][0], qm.AUTH | qm.PEER)
         entries: dict[int, dict[int, bytes]] = {i: {} for i in pending}
         extra_certs: dict[int, object] = {}  # embedded, not in keyring
         stally = _BatchTally(len(pending), qa.is_sufficient, qa.reject)
@@ -601,7 +642,7 @@ class Client(Protocol):
             )
             for i in pending
         ]
-        qw = self.qs.choose_quorum(qm.WRITE)
+        qw = qm.choose_quorum_for(self.qs, items[0][0], qm.WRITE)
         wtally = _BatchTally(len(pending), qw.is_threshold, qw.reject)
         with metrics.timer("client.write_many.phase_write"), trace.span(
             "phase.write", attrs={"peers": len(qw.nodes())}
@@ -643,8 +684,17 @@ class Client(Protocol):
         """
         if not variables:
             return []
+        groups = self._shard_groups(variables)
+        if groups is not None:
+            metrics.incr("client.read_many.shard_split")
+            results_all: list = [None] * len(variables)
+            for _shard, idx in groups:
+                sub = self.read_many([variables[i] for i in idx], proof)
+                for i, r in zip(idx, sub):
+                    results_all[i] = r
+            return results_all
         n = len(variables)
-        q = self.qs.choose_quorum(qm.READ)
+        q = qm.choose_quorum_for(self.qs, variables[0], qm.READ)
         reqs = [pkt.serialize(v, None, 0, None, proof) for v in variables]
         ms: list[dict] = [{} for _ in range(n)]
         fails: list[list] = [[] for _ in range(n)]
@@ -696,7 +746,9 @@ class Client(Protocol):
             # forgeable signature (see _resolve_complete_fanout_many).
             resolved: list[tuple[bytes | None, int] | None] = [None] * n
             try:
-                resolved = self._resolve_complete_fanout_many(ms, q)
+                resolved = self._resolve_complete_fanout_many(
+                    ms, q, key=variables[0]
+                )
             except Exception as e:
                 for k in range(n):
                     fails[k].append(e)
@@ -785,7 +837,7 @@ class Client(Protocol):
         (``_resolve_complete_fanout_many``)."""
         with metrics.timer("client.read.latency"), trace.span("client.read"):
             with trace.span("quorum.select"):
-                q = self.qs.choose_quorum(qm.READ)
+                q = qm.choose_quorum_for(self.qs, variable, qm.READ)
             req = pkt.serialize(variable, None, 0, None, proof)
             ch: "queue.Queue[tuple[bytes | None, Exception | None]]" = (
                 queue.Queue(maxsize=1)
@@ -848,7 +900,9 @@ class Client(Protocol):
             # collective signature endorses a strictly newer candidate
             # (see _resolve_complete_fanout_many).
             try:
-                (res0,) = self._resolve_complete_fanout_many([m], q)
+                (res0,) = self._resolve_complete_fanout_many(
+                    [m], q, key=variable
+                )
                 if res0 is not None:
                     value, maxt = res0
                     deliver(value, None)
@@ -909,7 +963,7 @@ class Client(Protocol):
         raise _InProgress
 
     def _resolve_complete_fanout_many(
-        self, ms: list[dict], q
+        self, ms: list[dict], q, key: bytes | None = None
     ) -> list[tuple[bytes | None, int] | None]:
         """Complete-fan-out fallback for a list of response maps,
         timestamps descending per item: a bucket wins by responder
@@ -960,7 +1014,10 @@ class Client(Protocol):
                         meta.append((k, t, val))
         if jobs:
             try:
-                qa = self.qs.choose_quorum(qm.AUTH)
+                # ``key`` keys the AUTH quorum to the shard being read:
+                # a candidate must be endorsed by the OWNER clique, not
+                # by whatever clique the unkeyed path would pick.
+                qa = qm.choose_quorum_for(self.qs, key or b"", qm.AUTH)
                 errs = self.crypt.collective.verify_many(
                     jobs, qa, self.crypt.keyring
                 )
@@ -1082,7 +1139,7 @@ class Client(Protocol):
         """Threshold password authentication.  Returns ``(proof, key)``:
         the collective-signature proof and the symmetric cipher key
         (reference: client.go:359-377)."""
-        q = self.qs.choose_quorum(qm.AUTH | qm.PEER)
+        q = qm.choose_quorum_for(self.qs, variable, qm.AUTH | qm.PEER)
         aclient = authmod.AuthClient(cred, len(q.nodes()), q.get_threshold())
         try:
             proof = self._do_authentication(aclient, variable, q)
@@ -1179,7 +1236,9 @@ class Client(Protocol):
     def distribute(self, caname: str, key) -> None:
         """Deal threshold shares of ``key`` to an AUTH quorum
         (reference: client.go:480-507)."""
-        q = self.qs.choose_quorum(qm.AUTH)
+        # The CA name keys the shard so distribute and dist_sign agree
+        # on which clique holds the threshold shares.
+        q = qm.choose_quorum_for(self.qs, caname.encode(), qm.AUTH)
         k = q.get_threshold()
         secrets, algo = self.threshold.distribute(key, q.nodes(), k)
         mpkt = [

@@ -61,6 +61,7 @@ from bftkv_tpu.errors import (
     ERR_PERMISSION_DENIED,
     ERR_TOO_MANY_ATTEMPTS,
     ERR_UNKNOWN_COMMAND,
+    ERR_WRONG_SHARD,
 )
 from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.protocol import MAX_UINT64, Protocol, Ref
@@ -205,6 +206,22 @@ class Server(Protocol):
             return lambda req, peer, sender: fn(self, cmd, req, peer, sender)
         return run
 
+    # -- keyspace sharding admission gate ---------------------------------
+
+    def _shard_check(self, variable: bytes) -> None:
+        """Reject data-plane requests for variables this replica's
+        shard does not own.  On unsharded trust graphs (and for quorum
+        systems without keyed routing) this is a no-op, so single-clique
+        clusters behave bit-for-bit as before.  The gate is what makes
+        cross-shard collective signatures unmintable: the only replicas
+        that will sign or store <x,...> are the owner clique's, so a
+        signature gathered anywhere else can never reach the owner
+        quorum's threshold."""
+        owns = getattr(self.qs, "owns", None)
+        if owns is not None and not owns(variable):
+            metrics.incr("server.wrong_shard")
+            raise ERR_WRONG_SHARD
+
     # -- membership (reference: server.go:64-120) -------------------------
 
     def _join(self, req: bytes, peer, sender) -> bytes | None:
@@ -245,6 +262,7 @@ class Server(Protocol):
         variable = req
         if variable.startswith(HIDDEN_PREFIX):
             raise ERR_PERMISSION_DENIED
+        self._shard_check(variable)
         t = 0
         try:
             raw = self.storage.read(variable, 0)
@@ -272,6 +290,7 @@ class Server(Protocol):
     def _read_item(self, variable: bytes, proof) -> bytes | None:
         if variable.startswith(HIDDEN_PREFIX):
             raise ERR_PERMISSION_DENIED
+        self._shard_check(variable)
         raw = None
         authenticated = None
         try:
@@ -304,7 +323,7 @@ class Server(Protocol):
                 self.crypt.collective.verify(
                     variable,
                     proof,
-                    self.qs.choose_quorum(qm.AUTH),
+                    qm.choose_quorum_for(self.qs, variable, qm.AUTH),
                     self.crypt.keyring,
                     use_cache=False,
                 )
@@ -339,6 +358,7 @@ class Server(Protocol):
         # stored there by _distribute.
         if variable.startswith(HIDDEN_PREFIX):
             raise ERR_PERMISSION_DENIED
+        self._shard_check(variable)
 
         # Verify the writer's signature with its own certificate.
         issuer = sigmod.issuer(sig, self.crypt.keyring)
@@ -468,7 +488,7 @@ class Server(Protocol):
                     self.crypt.collective.verify(
                         variable,
                         ss,
-                        self.qs.choose_quorum(qm.AUTH),
+                        qm.choose_quorum_for(self.qs, variable, qm.AUTH),
                         self.crypt.keyring,
                         use_cache=False,
                     )
@@ -498,8 +518,11 @@ class Server(Protocol):
             raise ERR_MALFORMED_REQUEST
         if variable.startswith(HIDDEN_PREFIX):
             raise ERR_PERMISSION_DENIED
+        self._shard_check(variable)
 
-        # Sufficient quorum members must have signed the same <x,v,t>.
+        # Sufficient quorum members must have signed the same <x,v,t> —
+        # against the OWNER shard's quorum, so a collective signature
+        # gathered from another clique is rejected in admission.
         tbss = pkt.tbss(req)
         with trace.span(
             "server.verify_batch",
@@ -509,7 +532,10 @@ class Server(Protocol):
             },
         ):
             self.crypt.collective.verify(
-                tbss, ss, self.qs.choose_quorum(qm.AUTH), self.crypt.keyring
+                tbss,
+                ss,
+                qm.choose_quorum_for(self.qs, variable, qm.AUTH),
+                self.crypt.keyring,
             )
 
         out = self._write_storage_checks(variable, val, t, sig, ss, req)
@@ -609,6 +635,7 @@ class Server(Protocol):
             raise ERR_MALFORMED_REQUEST
         if variable.startswith(HIDDEN_PREFIX):
             raise ERR_PERMISSION_DENIED
+        self._shard_check(variable)
         # Do NOT verify the signature here — it is kept with the auth
         # data for future use (reference: server.go:385).
         try:
@@ -750,6 +777,7 @@ class Server(Protocol):
             raise ERR_MALFORMED_REQUEST
         if variable.startswith(HIDDEN_PREFIX):
             raise ERR_PERMISSION_DENIED
+        self._shard_check(variable)
 
         issuer = sigmod.issuer(sig, self.crypt.keyring)
         tbs = pkt.tbs(req)
@@ -760,7 +788,7 @@ class Server(Protocol):
         self.crypt.collective.verify(
             variable,
             ss,
-            self.qs.choose_quorum(qm.AUTH),
+            qm.choose_quorum_for(self.qs, variable, qm.AUTH),
             self.crypt.keyring,
             use_cache=False,
         )
@@ -951,6 +979,7 @@ class Server(Protocol):
                     raise ERR_MALFORMED_REQUEST
                 if (p.variable or b"").startswith(HIDDEN_PREFIX):
                     raise ERR_PERMISSION_DENIED
+                self._shard_check(p.variable or b"")
                 packets[i] = p
             except Exception as e:
                 results[i] = (_errstr(e), b"")
@@ -1114,6 +1143,7 @@ class Server(Protocol):
                     raise ERR_MALFORMED_REQUEST
                 if variable.startswith(HIDDEN_PREFIX):
                     raise ERR_PERMISSION_DENIED
+                self._shard_check(variable)
                 parsed[i] = (p, r)
                 jobs.append((pkt.tbss(r), ss))
                 jidx.append(i)
@@ -1121,12 +1151,18 @@ class Server(Protocol):
                 results[i] = (_errstr(e), b"")
 
         if jobs:
+            # Every surviving item passed _shard_check, so they all
+            # share this replica's shard — one keyed AUTH quorum
+            # verifies the whole frame.
+            qa = qm.choose_quorum_for(
+                self.qs, parsed[jidx[0]][0].variable or b"", qm.AUTH
+            )
             with metrics.timer("server.batch_write.verify"), trace.span(
                 "server.verify_batch",
                 attrs={"batch_size": len(jobs), "kind": "collective"},
             ):
                 verrs = self.crypt.collective.verify_many(
-                    jobs, self.qs.choose_quorum(qm.AUTH), self.crypt.keyring
+                    jobs, qa, self.crypt.keyring
                 )
             for j, i in enumerate(jidx):
                 if verrs[j] is not None:

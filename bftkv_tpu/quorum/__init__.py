@@ -17,7 +17,17 @@ AUTH = 0x04
 CERT = 0x08
 PEER = 0x10
 
-__all__ = ["READ", "WRITE", "AUTH", "CERT", "PEER", "Quorum", "QuorumSystem"]
+__all__ = [
+    "READ",
+    "WRITE",
+    "AUTH",
+    "CERT",
+    "PEER",
+    "Quorum",
+    "QuorumSystem",
+    "KeyedQuorumSystem",
+    "choose_quorum_for",
+]
 
 
 @runtime_checkable
@@ -38,3 +48,25 @@ class Quorum(Protocol):
 @runtime_checkable
 class QuorumSystem(Protocol):
     def choose_quorum(self, rw: int) -> Quorum: ...
+
+
+@runtime_checkable
+class KeyedQuorumSystem(QuorumSystem, Protocol):
+    """Keyed variant: one namespace, many quorums.  ``x`` (the variable
+    name) routes to the quorum clique that owns it, so all phases of one
+    operation — time, sign-collect, write, read, certificate checks —
+    agree on the shard.  Implementations MUST degenerate to
+    ``choose_quorum(rw)`` on single-clique trust graphs."""
+
+    def choose_quorum_for(self, x: bytes, rw: int) -> Quorum: ...
+
+
+def choose_quorum_for(qs, x: bytes, rw: int) -> Quorum:
+    """Route through the keyed API when the quorum system has one,
+    falling back to the unkeyed ``choose_quorum`` otherwise — the ONE
+    seam every protocol call site goes through, so custom/test quorum
+    systems keep working unmodified."""
+    fn = getattr(qs, "choose_quorum_for", None)
+    if fn is not None:
+        return fn(x, rw)
+    return qs.choose_quorum(rw)

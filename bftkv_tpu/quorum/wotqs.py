@@ -26,12 +26,26 @@ batched device tallies in ``bftkv_tpu.ops.tally`` for bulk paths
 
 from __future__ import annotations
 
+import hashlib
 import threading
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from bftkv_tpu import quorum as q
+from bftkv_tpu.metrics import registry as metrics
+
+#: Keyspace routing granularity: ``sha256(x)[0]`` — deliberately the
+#: same bucketing as the anti-entropy digest tree
+#: (``bftkv_tpu.sync.digest.bucket_of``), so one digest bucket is owned
+#: by exactly one shard and "sync only what your cliques own" is a
+#: bucket-set intersection, not a per-variable walk.
+ROUTE_BUCKETS = 256
+
+
+def route_bucket(x: bytes) -> int:
+    """The routing bucket of a variable name."""
+    return hashlib.sha256(x).digest()[0]
 
 
 def _howmany(a: int, b: int) -> int:
@@ -138,6 +152,73 @@ class WotQuorum:
         }
 
 
+class _ShardTopo:
+    """One generation's shard view: the disjoint clique list, the
+    256-bucket HRW route table, and the complement-node assignment.
+
+    Everything here is a pure function of the addressed-node edge set,
+    which is identical in every principal's graph view (certificates
+    carry their own signature sets), so clients, clique replicas, and
+    storage nodes all route a key to the same shard without any
+    coordination."""
+
+    __slots__ = ("shards", "table", "member", "assign")
+
+    def __init__(self, graph):
+        self.shards = graph.get_disjoint_cliques(min_size=4)
+        # Deterministic shard order: by smallest member id.
+        self.shards.sort(key=lambda c: min(n.id for n in c.nodes))
+        #: node id -> shard index, clique members only.
+        self.member: dict[int, int] = {
+            n.id: i for i, c in enumerate(self.shards) for n in c.nodes
+        }
+        nsh = len(self.shards)
+        if nsh <= 1:
+            self.table = []
+            self.assign = {}
+            return
+        # Rendezvous (HRW) hash: bucket b belongs to the clique with the
+        # highest sha256(clique id | b); clique id = smallest member id.
+        # Adding/removing one clique moves only that clique's buckets.
+        cids = [
+            min(n.id for n in c.nodes).to_bytes(8, "big")
+            for c in self.shards
+        ]
+        self.table = [
+            max(
+                range(nsh),
+                key=lambda i: hashlib.sha256(
+                    cids[i] + bytes([b])
+                ).digest(),
+            )
+            for b in range(ROUTE_BUCKETS)
+        ]
+        # Complement (storage-plane) nodes — addressed, in no clique —
+        # are partitioned round-robin in ascending-id order so every
+        # shard keeps a balanced READ/WRITE complement ("W = U - {Ci}
+        # + R" per shard instead of one global W that would drag every
+        # storage node into every shard's write fan-out).
+        comp = sorted(
+            vid
+            for vid, v in graph.vertices.items()
+            if v.instance is not None
+            and getattr(v.instance, "address", "")
+            and vid not in self.member
+        )
+        self.assign = {vid: i % nsh for i, vid in enumerate(comp)}
+
+    def shard_index_of(self, node_id: int) -> int | None:
+        i = self.member.get(node_id)
+        if i is not None:
+            return i
+        return self.assign.get(node_id)
+
+    def shard_of_bucket(self, b: int) -> int | None:
+        if not self.table:
+            return None
+        return self.table[b]
+
+
 class WotQS:
     """The quorum system over a trust graph (wotqs.go:32-34).
 
@@ -154,6 +235,17 @@ class WotQS:
         self._cache: dict[int, WotQuorum] = {}
         self._cache_gen: int | None = None
         self._cache_lock = threading.Lock()
+        # Keyed-routing state, all memoized per graph generation under
+        # the same guard discipline as ``_cache``:
+        #   _topo       — shard cliques + bucket route table + complement
+        #                 assignment (one _ShardTopo, O(V^2) to build);
+        #   _kcache     — (rw, shard index) -> WotQuorum for shards this
+        #                 node is NOT a member of (members delegate to
+        #                 the classic path and its memo).
+        self._topo: _ShardTopo | None = None
+        self._topo_gen: int | None = None
+        self._kcache: dict[tuple[int, int], WotQuorum] = {}
+        self._kcache_gen: int | None = None
 
     def _new_qc(self, nodes: list, weight: int, rw: int) -> QC | None:
         if rw & q.PEER:
@@ -213,7 +305,9 @@ class WotQS:
             else:
                 quorum = self._cache.get(rw)
                 if quorum is not None:
+                    metrics.incr("quorum.cache.hits")
                     return quorum
+        metrics.incr("quorum.cache.misses")
         if rw & q.CERT:
             distance = 0
         elif rw & q.AUTH:
@@ -232,3 +326,165 @@ class WotQS:
                 ):
                     self._cache[rw] = quorum
         return quorum
+
+    # -- keyed routing: one namespace, many quorums (ROADMAP item 2) ------
+
+    def _topology(self) -> _ShardTopo:
+        """The generation's shard topology, memoized with the same
+        mutation guard as :meth:`choose_quorum` — a topology computed
+        from the pre-mutation graph is never cached under the
+        post-mutation generation."""
+        gen = getattr(self.g, "generation", None)
+        with self._cache_lock:
+            if (
+                gen is not None
+                and gen == self._topo_gen
+                and self._topo is not None
+            ):
+                return self._topo
+        topo = _ShardTopo(self.g)
+        if gen is not None:
+            with self._cache_lock:
+                if getattr(self.g, "generation", None) == gen:
+                    self._topo = topo
+                    self._topo_gen = gen
+        return topo
+
+    def shard_count(self) -> int:
+        return len(self._topology().shards)
+
+    def shard_of(self, x: bytes) -> int | None:
+        """The shard index owning variable ``x`` (None = unsharded)."""
+        return self._topology().shard_of_bucket(route_bucket(x))
+
+    def shard_index_of(self, node_id: int) -> int | None:
+        """Which shard a node serves: its clique's index, or — for a
+        complement/storage node — its round-robin assignment.  None for
+        unassigned principals (users) or unsharded graphs."""
+        topo = self._topology()
+        if len(topo.shards) <= 1:
+            return None
+        return topo.shard_index_of(node_id)
+
+    def my_shard(self) -> int | None:
+        return self.shard_index_of(self.g.get_self_id())
+
+    def owns(self, x: bytes) -> bool:
+        """Admission gate: does this node's shard own ``x``?  Always
+        True on unsharded graphs and for unassigned principals."""
+        topo = self._topology()
+        if len(topo.shards) <= 1:
+            return True
+        mine = topo.shard_index_of(self.g.get_self_id())
+        if mine is None:
+            return True
+        return topo.shard_of_bucket(route_bucket(x)) == mine
+
+    def shard_buckets(self) -> list[int]:
+        """Route buckets assigned to each shard (``[ROUTE_BUCKETS]``
+        when unsharded) — the balance series benches report."""
+        topo = self._topology()
+        if len(topo.shards) <= 1:
+            return [ROUTE_BUCKETS]
+        counts = [0] * len(topo.shards)
+        for i in topo.table:
+            counts[i] += 1
+        return counts
+
+    def owned_buckets(self) -> set[int] | None:
+        """The route buckets this node's shard owns, or None when every
+        bucket is local (unsharded graph / unassigned principal) — the
+        anti-entropy plane's pull filter."""
+        topo = self._topology()
+        if len(topo.shards) <= 1:
+            return None
+        mine = topo.shard_index_of(self.g.get_self_id())
+        if mine is None:
+            return None
+        return {b for b in range(ROUTE_BUCKETS) if topo.table[b] == mine}
+
+    def choose_quorum_for(self, x: bytes, rw: int) -> WotQuorum:
+        """Keyed quorum selection: hash-route ``x`` to its owner clique.
+
+        Single-clique graphs take the classic path unchanged (same
+        memo, same objects).  A member of the owner clique also takes
+        the classic path — its BFS view IS the owner shard, so the
+        distance semantics (CERT: 0, AUTH: 1) stay intact.  Only a
+        non-member (a client, or a storage node verifying a foreign
+        shard's record) builds the owner-clique quorum explicitly,
+        with READ/WRITE complements drawn from the shard's complement
+        partition so no operation ever fans out beyond its shard."""
+        # Read the generation BEFORE fetching the topology: a mutation
+        # landing between the two makes gen newer than the topo and the
+        # store guard below rejects the result — reading gen after
+        # would let a quorum built from a pre-mutation topology slip
+        # into the cache under the post-mutation generation.
+        gen = getattr(self.g, "generation", None)
+        topo = self._topology()
+        if len(topo.shards) <= 1:
+            return self.choose_quorum(rw)
+        idx = topo.table[route_bucket(x)]
+        metrics.incr("quorum.route.shard", labels={"shard": idx})
+        if topo.member.get(self.g.get_self_id()) == idx:
+            return self.choose_quorum(rw)
+        key = (rw, idx)
+        with self._cache_lock:
+            if gen is None or gen != self._kcache_gen:
+                self._kcache.clear()
+                self._kcache_gen = gen
+            else:
+                quorum = self._kcache.get(key)
+                if quorum is not None:
+                    metrics.incr("quorum.cache.hits")
+                    return quorum
+        metrics.incr("quorum.cache.misses")
+        quorum = self._quorum_for_shard(rw, idx, topo)
+        if gen is not None:
+            with self._cache_lock:
+                if (
+                    self._kcache_gen == gen
+                    and getattr(self.g, "generation", None) == gen
+                ):
+                    self._kcache[key] = quorum
+        return quorum
+
+    def _quorum_for_shard(
+        self, rw: int, idx: int, topo: _ShardTopo
+    ) -> WotQuorum:
+        """Build the owner clique's quorum from a non-member's seat —
+        the same b-masking construction as :meth:`_quorum_from`, with
+        two shard-local substitutions: the clique comes from the global
+        enumeration (BFS cannot reach a foreign clique), and the
+        READ/WRITE complements keep only nodes assigned to this shard's
+        complement partition."""
+        owner = topo.shards[idx]
+        sid = self.g.get_self_id()
+        nodes = list(owner.nodes)
+        weight = self.g.weight_from(sid, nodes)
+        qcs: list[QC] = []
+        qc = self._new_qc(nodes, weight, rw | q.AUTH)
+        if qc is not None:
+            qcs.append(qc)
+        if rw & (q.READ | q.WRITE):
+            if rw & q.CERT:
+                distance = 0
+            elif rw & q.AUTH:
+                distance = 1
+            else:
+                distance = 2
+
+            def local(n) -> bool:
+                return topo.assign.get(n.id) == idx
+
+            e = qcs if rw & q.AUTH else []
+            reach = [
+                n
+                for n in self.g.get_reachable_nodes(sid, distance)
+                if local(n)
+            ]
+            e = self._complement(reach, qcs, e, q.READ)  # R = {Vi} - {Ci}
+            if rw & q.WRITE:
+                peers = [n for n in self.g.get_peers() if local(n)]
+                e = self._complement(peers, qcs + e, e, q.WRITE)
+            qcs = e
+        return WotQuorum(qcs)

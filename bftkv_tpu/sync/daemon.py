@@ -72,12 +72,19 @@ def admit_records(server, records: list[bytes]) -> dict:
     stats = {"admitted": 0, "rejected": 0, "stale": 0}
     parsed: list[tuple[bytes, object, bytes] | None] = []
     jobs: list[tuple[bytes, object]] = []
+    owns = getattr(server.qs, "owns", None)
     for raw in records[:MAX_PULL_RECORDS]:
         try:
             p = pkt.parse(raw)
             variable = p.variable or b""
             if variable.startswith(HIDDEN_PREFIX):
                 raise ValueError("hidden variable")
+            if owns is not None and not owns(variable):
+                # Sharded namespace: records of foreign shards never
+                # enter local state (the same gate the write handler
+                # applies) — a peer cannot use the sync plane to park
+                # another shard's history here.
+                raise ValueError("wrong shard")
             if p.sig is None or p.ss is None or not p.ss.completed:
                 raise ValueError("not a completed record")
             if p.auth is not None:
@@ -106,13 +113,24 @@ def admit_records(server, records: list[bytes]) -> dict:
     # routes through the installed ops.dispatch verify dispatcher, so a
     # whole pull costs one kernel launch, not per-record host checks.
     if jobs:
+        # Keyed to the OWNER quorum, exactly like the write handler:
+        # every surviving record passed the owns() gate above, so they
+        # all share this replica's shard and one keyed quorum covers
+        # the batch.  The unkeyed quorum would accept a foreign
+        # clique's threshold (is_sufficient is any-QC), letting a
+        # Byzantine peer launder another shard's signatures through
+        # the sync plane.
+        first_var = next(
+            e[1].variable or b"" for e in parsed if e is not None
+        )
+        qa = qm.choose_quorum_for(server.qs, first_var, qm.AUTH)
         metrics.observe("sync.pull.verify_batch", len(jobs))
         with trace.span(
             "server.verify_batch",
             attrs={"batch_size": len(jobs), "kind": "sync_pull"},
         ):
             verrs = server.crypt.collective.verify_many(
-                jobs, server.qs.choose_quorum(qm.AUTH), server.crypt.keyring
+                jobs, qa, server.crypt.keyring
             )
     else:
         verrs = []
@@ -200,10 +218,24 @@ class SyncDaemon:
     # -- one round ---------------------------------------------------------
 
     def _peers(self) -> list:
-        return [
+        peers = [
             n
             for n in self.server.self_node.get_peers()
             if getattr(n, "address", "") and getattr(n, "active", True)
+        ]
+        # Sharded namespace: only same-shard peers can hold records we
+        # own (every replica applies the wrong-shard admission gate), so
+        # polling foreign shards is pure waste.  Peers without a shard
+        # assignment are kept — fail open, admission stays the shield.
+        qs = getattr(self.server, "qs", None)
+        idx_of = getattr(qs, "shard_index_of", None)
+        if idx_of is None:
+            return peers
+        mine = idx_of(self.server.self_node.get_self_id())
+        if mine is None:
+            return peers
+        return [
+            n for n in peers if idx_of(n.id) is None or idx_of(n.id) == mine
         ]
 
     def _ask(self, cmd: int, peer, payload: bytes) -> bytes | None:
@@ -258,6 +290,16 @@ class SyncDaemon:
         # peer absorb the whole round's pull budget.
         f = len(peers) // 3
         local = self.server._sync_tree()
+        # Shard-aware digest comparison: only buckets this replica's
+        # shard owns are worth pulling — a foreign shard's buckets
+        # diverge forever by design (their records die in our
+        # admission), and without the filter every round would re-pull
+        # them just to reject them.
+        owned = None
+        get_owned = getattr(getattr(self.server, "qs", None),
+                            "owned_buckets", None)
+        if get_owned is not None:
+            owned = get_owned()
         divergent_peers: list[tuple[object, list[int]]] = []
         for peer in peers:
             stats["peers"] += 1
@@ -272,7 +314,9 @@ class SyncDaemon:
                 continue
             mine = local.buckets()
             divergent = [
-                b for b, h in sorted(theirs.items()) if mine.get(b) != h
+                b
+                for b, h in sorted(theirs.items())
+                if mine.get(b) != h and (owned is None or b in owned)
             ]
             if divergent:
                 divergent_peers.append((peer, divergent))

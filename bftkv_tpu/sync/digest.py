@@ -20,6 +20,14 @@ Two replicas with equal trees serve identical completed state; a
 divergent bucket names the (at most 1/256th) slice of the keyspace to
 pull.  The reference has no analog — its only repair plane is client
 read-repair (protocol/client.go:281-302).
+
+Sharding interplay: a digest bucket is exactly one *routing* bucket
+(``quorum.wotqs.route_bucket`` uses the same ``sha256(x)[0]``), so
+shard ownership partitions the tree cleanly.  The tree itself stays
+shard-blind on purpose — it summarizes what the replica HAS, including
+buckets a routing-generation change just took away, which is how a new
+owner pulls migrated state (the old owner serves it; the pull filter
+and the admission gate live on the *consuming* side, sync/daemon.py).
 """
 
 from __future__ import annotations

@@ -116,6 +116,10 @@ class Universe:
     # setup.sh gives servers no path to rw, so its debug-API reads
     # cannot reach a read quorum either).
     server_trust_rw: bool = False
+    # Keyspace sharding: ``servers`` grouped by quorum clique (one
+    # group per shard; [servers] when unsharded).  Populated by
+    # build_universe; consumers that predate sharding can ignore it.
+    shards: list[list[Identity]] = field(default_factory=list)
 
     @property
     def all(self) -> list[Identity]:
@@ -160,6 +164,12 @@ class Universe:
         return []
 
 
+#: Shard-group name prefixes.  'r' and 'u' are skipped: "rXX" would
+#: collide with the rw storage names and "uXX" with users (and the
+#: cluster runner treats u* homes as clients).
+_SHARD_PREFIXES = "abcdefghijklmnopqstvwxyz"
+
+
 def build_universe(
     n_servers: int = 4,
     n_users: int = 1,
@@ -172,6 +182,7 @@ def build_universe(
     unsigned_users: int = 0,
     server_trust_rw: bool = False,
     alg: str = certmod.ALG_RSA,
+    n_shards: int = 1,
 ) -> Universe:
     """The canonical test topology (reference: scripts/setup.sh:17-48).
 
@@ -183,7 +194,18 @@ def build_universe(
     "p256", or "mixed" (alternating, exercising algorithm agility in
     one cluster the way the reference's PGP layer would accept mixed
     keyrings).
+
+    ``n_shards``: keyspace sharding — build ``n_shards`` disjoint
+    server cliques of ``n_servers`` each (named a01.., b01.., c01..)
+    and ``n_rw`` storage nodes *per shard*.  ``n_servers``/``n_rw``
+    are PER-SHARD counts.  Users sign the non-counter-signing servers
+    of every shard and are counter-signed by every shard's last f+1
+    servers, so one client identity carries a valid quorum certificate
+    at every clique.  ``n_shards=1`` is byte-compatible with the
+    pre-sharding topology.
     """
+    if not 1 <= n_shards <= len(_SHARD_PREFIXES):
+        raise ValueError(f"n_shards must be 1..{len(_SHARD_PREFIXES)}")
 
     def alg_for(i: int) -> str:
         if alg == "mixed":
@@ -195,17 +217,27 @@ def build_universe(
             return f"loop://{name}"
         return f"http://127.0.0.1:{port}"
 
-    servers = [
-        new_identity(
-            f"a{i + 1:02d}",
-            address=addr(f"a{i + 1:02d}", base_port + i),
-            uid=f"a{i + 1:02d}@server",
-            bits=bits,
-            alg=alg_for(i),
-        )
-        for i in range(n_servers)
-    ]
-    cross_sign(servers)
+    shards: list[list[Identity]] = []
+    for s in range(n_shards):
+        prefix = _SHARD_PREFIXES[s]
+        group = [
+            new_identity(
+                f"{prefix}{i + 1:02d}",
+                address=addr(
+                    f"{prefix}{i + 1:02d}",
+                    base_port + s * n_servers + i,
+                ),
+                uid=f"{prefix}{i + 1:02d}@server",
+                bits=bits,
+                alg=alg_for(i),
+            )
+            for i in range(n_servers)
+        ]
+        # Cross-sign within the shard only: the cliques must stay
+        # disjoint or clique discovery merges them into one quorum.
+        cross_sign(group)
+        shards.append(group)
+    servers = [s for group in shards for s in group]
 
     storage_nodes = [
         new_identity(
@@ -215,11 +247,13 @@ def build_universe(
             bits=bits,
             alg=alg_for(i),
         )
-        for i in range(n_rw)
+        for i in range(n_rw * n_shards)
     ]
 
     f = (n_servers - 1) // 3
-    cert_signers = servers[-(f + 1) :] if servers else []
+    cert_signers = [
+        s for group in shards for s in (group[-(f + 1) :] if group else [])
+    ]
 
     users = []
     for i in range(n_users):
@@ -240,6 +274,7 @@ def build_universe(
         users=users,
         cert_signer_ids={s.id for s in cert_signers},
         server_trust_rw=server_trust_rw,
+        shards=shards,
     )
 
 

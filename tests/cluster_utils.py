@@ -57,11 +57,14 @@ def start_cluster(
     transport_cls=TrLoopback,
     transport: str = "loop",
     alg: str = "rsa",
+    n_shards: int = 1,
 ) -> Cluster:
     """``transport="loop"`` wires the in-process loopback net;
     ``transport="http"`` starts every server on a real localhost HTTP
     port — the reference's tier-3 shape (protocol/test_utils.go:24-82,
-    one process, loopback sockets)."""
+    one process, loopback sockets).  ``n_shards`` builds that many
+    disjoint server cliques (``n_servers``/``n_rw`` become per-shard
+    counts — see topology.build_universe)."""
     if transport == "http":
         http_cls = TrHTTP if transport_cls is TrLoopback else transport_cls
         if not (isinstance(http_cls, type) and issubclass(http_cls, TrHTTP)):
@@ -72,14 +75,14 @@ def start_cluster(
         uni = topology.build_universe(
             n_servers, n_users, n_rw, scheme="http", bits=bits,
             base_port=base, rw_base_port=base + 50,
-            unsigned_users=unsigned_users, alg=alg,
+            unsigned_users=unsigned_users, alg=alg, n_shards=n_shards,
         )
         net = None
         make_tr = lambda crypt: http_cls(crypt)
     else:
         uni = topology.build_universe(
             n_servers, n_users, n_rw, scheme="loop", bits=bits,
-            unsigned_users=unsigned_users, alg=alg,
+            unsigned_users=unsigned_users, alg=alg, n_shards=n_shards,
         )
         net = LoopbackNet()
         make_tr = lambda crypt: transport_cls(crypt, net)

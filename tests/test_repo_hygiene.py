@@ -1,0 +1,28 @@
+"""Repo hygiene: no orphaned bytecode in the package tree.
+
+The gateway prototype left six ``.pyc`` files in
+``bftkv_tpu/gateway/__pycache__/`` whose source was never committed
+(ROADMAP item 1) — bytecode that outlives its module is at best dead
+weight and at worst something importable that no review ever saw.
+Every compiled module under the package must have its matching ``.py``
+next to the ``__pycache__`` directory.
+"""
+
+from pathlib import Path
+
+import bftkv_tpu
+
+
+def test_no_orphaned_bytecode():
+    pkg = Path(bftkv_tpu.__file__).resolve().parent
+    orphans = []
+    for pyc in pkg.rglob("__pycache__/*.pyc"):
+        # cpython bytecode names look like "module.cpython-310.pyc".
+        stem = pyc.name.split(".", 1)[0]
+        src = pyc.parent.parent / f"{stem}.py"
+        if not src.exists():
+            orphans.append(str(pyc.relative_to(pkg)))
+    assert not orphans, (
+        "bytecode without committed source (delete it or commit the "
+        f"module): {orphans}"
+    )

@@ -1,0 +1,179 @@
+"""2-shard loopback cluster smoke (tier-1): one client-visible
+keyspace, hash-routed across two disjoint quorum cliques.
+
+Covers the full keyed path end to end: routed writes/reads, storage
+placement (a shard's records never land on the other shard's
+replicas), the wrong-shard admission gate, batched write/read shard
+grouping, and the shard-aware anti-entropy plane.
+"""
+
+import pytest
+
+from bftkv_tpu import quorum as qm
+from bftkv_tpu.errors import ERR_WRONG_SHARD
+from bftkv_tpu.sync import SyncDaemon, admit_records
+from tests.cluster_utils import start_cluster
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    cl = start_cluster(4, 1, 4, bits=1024, n_shards=2)
+    yield cl
+    cl.stop()
+
+
+def keys_per_shard(client, count=1, tag=b"k"):
+    """{shard index: [keys]} with ``count`` keys per shard."""
+    out: dict = {}
+    i = 0
+    while (
+        min((len(v) for v in out.values()), default=0) < count
+        or len(out) < 2
+    ) and i < 4096:
+        k = b"shard/%s/%d" % (tag, i)
+        out.setdefault(client.qs.shard_of(k), []).append(k)
+        i += 1
+    return out
+
+
+def shard_servers(cluster, idx):
+    return [
+        s
+        for s in cluster.all_servers
+        if s.qs.my_shard() == idx
+    ]
+
+
+def test_write_read_across_shards(cluster):
+    c = cluster.clients[0]
+    assert c.qs.shard_count() == 2
+    ks = keys_per_shard(c, count=2)
+    assert set(ks) == {0, 1}
+    for idx, keys in ks.items():
+        for k in keys:
+            c.write(k, b"v-" + k)
+    for idx, keys in ks.items():
+        for k in keys:
+            assert c.read(k) == b"v-" + k
+
+
+def test_storage_placement(cluster):
+    c = cluster.clients[0]
+    ks = keys_per_shard(c, tag=b"place")
+    for idx, keys in ks.items():
+        k = keys[0]
+        c.write(k, b"placed")
+        other = 1 - idx
+        for srv in shard_servers(cluster, other):
+            with pytest.raises(Exception):
+                srv.storage.read(k, 0)
+        # ...and at least one replica of the owner shard has it.
+        assert any(
+            _has(srv, k) for srv in shard_servers(cluster, idx)
+        ), (idx, k)
+
+
+def _has(srv, k):
+    try:
+        srv.storage.read(k, 0)
+        return True
+    except Exception:
+        return False
+
+
+def test_wrong_shard_admission_rejected(cluster):
+    c = cluster.clients[0]
+    ks = keys_per_shard(c, tag=b"adm")
+    for idx, keys in ks.items():
+        k = keys[0]
+        for srv in shard_servers(cluster, 1 - idx):
+            with pytest.raises(ERR_WRONG_SHARD):
+                srv._time(k, None, None)
+
+
+def test_batched_paths_split_by_shard(cluster):
+    c = cluster.clients[0]
+    ks = keys_per_shard(c, count=3, tag=b"batch")
+    items = [(k, b"b-" + k) for keys in ks.values() for k in keys]
+    assert len({c.qs.shard_of(k) for k, _v in items}) == 2
+    errs = c.write_many(items)
+    assert errs == [None] * len(items)
+    got = c.read_many([k for k, _v in items])
+    assert got == [v for _k, v in items]
+
+
+def test_keyed_quorum_nodes_stay_in_shard(cluster):
+    c = cluster.clients[0]
+    ks = keys_per_shard(c, tag=b"quorum")
+    for idx, keys in ks.items():
+        k = keys[0]
+        for rw in (qm.READ | qm.AUTH, qm.AUTH | qm.PEER, qm.WRITE):
+            nodes = qm.choose_quorum_for(c.qs, k, rw).nodes()
+            assert nodes
+            for n in nodes:
+                assert c.qs.shard_index_of(n.id) == idx, (
+                    k, rw, n.name,
+                )
+
+
+def test_sync_verify_quorum_is_keyed(cluster):
+    """A storage node's UNKEYED AUTH quorum holds both cliques as
+    separate QCs and ``is_sufficient`` is any-QC — so a foreign
+    clique's signature threshold would pass it.  The sync plane (and
+    every other admission path) must therefore verify against the
+    keyed owner quorum, where the foreign clique counts for nothing."""
+    c = cluster.clients[0]
+    ks = keys_per_shard(c, tag=b"keyedq")
+    rw_a = next(
+        s for s in cluster.storage_servers if s.qs.my_shard() == 0
+    )
+    k = ks[0][0]  # owned by rw_a's shard
+    topo = rw_a.qs._topology()
+    b_clique = [
+        n
+        for n in rw_a.self_node.get_peers()
+        if topo.member.get(n.id) == 1
+    ]
+    assert len(b_clique) == 4
+    # The laundering hole the keyed quorum closes: unkeyed accepts the
+    # foreign clique's threshold...
+    assert rw_a.qs.choose_quorum(qm.AUTH).is_sufficient(b_clique)
+    # ...the keyed owner quorum does not.
+    qa = qm.choose_quorum_for(rw_a.qs, k, qm.AUTH)
+    assert not qa.is_sufficient(b_clique)
+    assert not qa.is_threshold(b_clique)
+
+
+def test_sync_plane_is_shard_aware(cluster):
+    c = cluster.clients[0]
+    ks = keys_per_shard(c, tag=b"sync")
+    # Something synced exists in both shards.
+    for idx, keys in ks.items():
+        c.write(keys[0], b"sync-seed")
+    rw_a = next(
+        s
+        for s in cluster.storage_servers
+        if s.qs.my_shard() == 0
+    )
+    # 1. peer selection: only same-shard replicas are polled.
+    daemon = SyncDaemon(rw_a, interval=999)
+    for peer in daemon._peers():
+        assert rw_a.qs.shard_index_of(peer.id) in (None, 0)
+    # 2. a foreign shard's completed record dies in admission.
+    rw_b = next(
+        s
+        for s in cluster.storage_servers
+        if s.qs.my_shard() == 1
+    )
+    k_b = ks[1][0]
+    raw = rw_b.storage.read(k_b, 0)
+    stats = admit_records(rw_a, [raw])
+    assert stats["rejected"] == 1 and stats["admitted"] == 0
+    # ...while replaying an owned record is a clean no-op.
+    k_a = ks[0][0]
+    raw_a = rw_a.storage.read(k_a, 0)
+    stats = admit_records(rw_a, [raw_a])
+    assert stats["rejected"] == 0
+    # 3. a full round against live same-shard peers converges clean.
+    got = daemon.run_round()
+    assert got["rejected"] == 0
