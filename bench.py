@@ -1009,6 +1009,20 @@ def bench_cluster_shards(
                 for k, v in snap.items()
                 if k.startswith("quorum.route.shard{")
             }
+            # Per-shard write latency from the shard-labeled series the
+            # fleet collector merges — a straggling shard is visible
+            # here, not averaged away in the fleet-wide p50.
+            shard_p50 = {
+                k.split("shard=")[-1].rstrip("}"): round(v, 4)
+                for k, v in snap.items()
+                if k.startswith("client.write.latency.p50{")
+            }
+            wrong_shard = sum(
+                v
+                for k, v in snap.items()
+                if k.startswith("server.wrong_shard")
+                and ".count" not in k
+            )
             buckets = clients[0].qs.shard_buckets()
             entry = {
                 "shards": nsh,
@@ -1025,6 +1039,8 @@ def bench_cluster_shards(
                     snap.get("client.write.latency.p99", 0), 4
                 ),
                 "route_counts": route_counts,
+                "write_p50_by_shard": shard_p50,
+                "wrong_shard_rejects": wrong_shard,
                 "bucket_counts": buckets,
                 "bucket_balance_max_min": round(
                     max(buckets) / max(min(buckets), 1), 3

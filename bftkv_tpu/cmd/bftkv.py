@@ -121,7 +121,7 @@ class _ApiHandler(BaseHTTPRequestHandler):
     #: so hostile URLs cannot blow up label cardinality.
     _ENDPOINTS = frozenset(
         ("read", "write", "writeonce", "joining", "leaving", "show",
-         "visual", "debug", "metrics", "trace")
+         "visual", "debug", "metrics", "trace", "info")
     )
 
     def _handle(self):
@@ -243,6 +243,22 @@ class _ApiHandler(BaseHTTPRequestHandler):
                 from bftkv_tpu import trace as trmod
 
                 q = urllib.parse.parse_qs(urllib.parse.urlparse(path).query)
+                if "since" in q:
+                    # Incremental drain for the fleet collector: spans
+                    # after the caller's cursor + the slow ring (its
+                    # entries carry shard/peer attribution the /fleet
+                    # exemplars surface).
+                    try:
+                        since = int(q["since"][0])
+                    except ValueError:
+                        since = 0
+                    doc = trmod.tracer.export(max(0, since))
+                    doc["slow"] = trmod.tracer.slow()
+                    body = json.dumps(
+                        doc, sort_keys=True, default=str
+                    ).encode()
+                    self._reply(200, body, "application/json")
+                    return
                 try:
                     limit = int(q.get("limit", ["20"])[0])
                 except ValueError:
@@ -256,6 +272,11 @@ class _ApiHandler(BaseHTTPRequestHandler):
                     },
                     sort_keys=True,
                     default=str,
+                ).encode()
+                self._reply(200, body, "application/json")
+            elif path == "/info":
+                body = json.dumps(
+                    self.server.svc.info(), sort_keys=True
                 ).encode()
                 self._reply(200, body, "application/json")
             else:
@@ -278,6 +299,28 @@ class _ApiService:
         self.client = client
         self.graph = graph
         self.qs = qs  # the DAEMON's quorum system (not the client's)
+
+    def info(self) -> dict:
+        """Machine-readable identity + shard seat for the fleet
+        collector (``bftkv_tpu.obs``): who am I, which shard do I
+        serve, and the b-masking thresholds of that shard's clique —
+        computed HERE from the same ``quorum/wotqs.py`` state the
+        protocol uses, so the health plane can never drift from the
+        quorum math."""
+        from bftkv_tpu.obs.source import seat_document
+
+        g = self.graph
+        out: dict = {
+            "name": g.name,
+            "id": f"{g.id:016x}",
+            "addr": g.address,
+            "uid": g.uid,
+        }
+        qs = self.qs if self.qs is not None else getattr(
+            self.client, "qs", None
+        )
+        out.update(seat_document(qs, g.id))
+        return out
 
     def show(self) -> str:
         g = self.graph

@@ -1,0 +1,192 @@
+"""``fleet`` — the fleet health CLI (collector front end).
+
+One-shot report against a running fleet's daemon APIs::
+
+    python -m bftkv_tpu.cmd.fleet --api-base 7001 --count 8
+
+or watch continuously, or serve the collector's ``/fleet`` endpoint
+(JSON + Prometheus) for dashboards::
+
+    python -m bftkv_tpu.cmd.fleet --api-base 7001 --count 8 \
+        --watch --interval 2 --listen 127.0.0.1:7999
+
+``run_cluster --fleet PORT`` boots exactly this alongside the fleet.
+
+Exit codes (one-shot): 0 healthy, 1 some shard's f-budget is exhausted
+(``remaining < 0`` — more clique members dark than the b-masking bound
+tolerates), 2 nothing scrapeable.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+from bftkv_tpu.obs import FleetCollector, HTTPSource
+
+__all__ = ["main", "render"]
+
+
+def render(doc: dict) -> str:
+    """The one-shot human report for one health document."""
+    fl = doc["fleet"]
+    tr = doc["traces"]
+    lines = [
+        f"fleet: {fl['up']}/{fl['daemons']} daemons up · "
+        f"{len(doc['shards'])} shard(s) · "
+        f"{tr['traces']} traces ({tr['stitched']} stitched) · "
+        f"{len(doc['anomalies'])} anomalies"
+    ]
+    if fl.get("unseated"):
+        lines.append(
+            "UNSEATED (never answered /info — shard budgets "
+            f"indeterminate): {', '.join(fl['unseated'])}"
+        )
+    for sh, sd in sorted(doc["shards"].items()):
+        fb = sd["f_budget"]
+        slo = sd.get("slo", {})
+        w = slo.get("write")
+        slo_txt = (
+            f" · write p50≤{w['p50_le_s']:g}s p99≤{w['p99_le_s']:g}s "
+            f"(n={w['count']})"
+            if w
+            else ""
+        )
+        lines.append(
+            f"shard {sh}: n={sd['n']} f={sd['f']} "
+            f"2f+1={sd['threshold']} · "
+            f"budget {fb['remaining']}/{fb['f']}"
+            + (f" DOWN={','.join(fb['down'])}" if fb["down"] else "")
+            + (
+                f" storage-down={','.join(fb['storage_down'])}"
+                if fb["storage_down"]
+                else ""
+            )
+            + slo_txt
+        )
+        for mem in sd["members"]:
+            mark = "·" if mem["status"] == "up" else "✗"
+            lines.append(
+                f"  {mark} {mem['name']} [{mem['role'] or '?'}] "
+                f"{mem['status']}"
+            )
+        for ex in sd.get("exemplars", [])[-3:]:
+            lines.append(
+                f"  slow: {ex['root']} {ex['duration']}s "
+                f"trace={ex['trace_id']}"
+                + (f" peer={ex['peer']}" if "peer" in ex else "")
+            )
+    for a in doc["anomalies"][-8:]:
+        lines.append(
+            f"anomaly #{a['seq']} {a['kind']} src={a['source']} "
+            f"shard={a['shard']} {a['detail']} x{a['count']}"
+        )
+    return "\n".join(lines)
+
+
+def _watch_line(doc: dict) -> str:
+    budgets = " ".join(
+        f"s{sh}:{sd['f_budget']['remaining']}/{sd['f_budget']['f']}"
+        for sh, sd in sorted(doc["shards"].items())
+    )
+    return (
+        f"[{time.strftime('%H:%M:%S')}] up={doc['fleet']['up']}"
+        f"/{doc['fleet']['daemons']} budget {budgets} "
+        f"traces={doc['traces']['traces']}"
+        f"({doc['traces']['stitched']} stitched) "
+        f"anomalies={len(doc['anomalies'])}"
+    )
+
+
+def _exit_code(doc: dict) -> int:
+    if doc["fleet"]["up"] == 0:
+        return 2
+    if any(
+        sd["f_budget"]["remaining"] < 0 for sd in doc["shards"].values()
+    ):
+        return 1
+    if doc["fleet"].get("unseated"):
+        # A member whose seat was never learned: the per-shard budgets
+        # cannot be trusted while it is unaccounted for.
+        return 1
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fleet health collector (bftkv_tpu.obs)"
+    )
+    ap.add_argument("--targets", default="",
+                    help="comma-separated daemon API addresses "
+                         "(host:port,host:port,...)")
+    ap.add_argument("--api-base", type=int, default=0,
+                    help="first daemon API port (run_cluster --api-base); "
+                         "use with --count")
+    ap.add_argument("--count", type=int, default=0,
+                    help="how many sequential API ports from --api-base")
+    ap.add_argument("--api-host", default="127.0.0.1")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="scrape interval seconds (watch/listen modes)")
+    ap.add_argument("--watch", action="store_true",
+                    help="keep scraping, one status line per interval")
+    ap.add_argument("--listen", default="",
+                    help="serve /fleet (JSON + Prometheus) on host:port; "
+                         "implies background scraping")
+    ap.add_argument("--json", action="store_true",
+                    help="one-shot: print the full health document as JSON")
+    ap.add_argument("--scrapes", type=int, default=1,
+                    help="one-shot: scrape this many times (interval apart) "
+                         "before reporting — 2+ arms counter-delta anomalies")
+    args = ap.parse_args(argv)
+
+    targets = [t for t in args.targets.split(",") if t.strip()]
+    if args.api_base and args.count:
+        targets += [
+            f"{args.api_host}:{args.api_base + i}" for i in range(args.count)
+        ]
+    if not targets:
+        print("fleet: no targets (--targets or --api-base/--count)",
+              file=sys.stderr)
+        return 2
+
+    collector = FleetCollector(
+        [HTTPSource(t) for t in targets], interval=args.interval
+    )
+
+    if args.listen or args.watch:
+        collector.start(args.interval)
+        httpd = None
+        if args.listen:
+            from bftkv_tpu.obs.http import serve_fleet
+
+            httpd = serve_fleet(collector, args.listen)
+            print(f"fleet: /fleet @ {args.listen}", flush=True)
+        try:
+            while True:
+                time.sleep(args.interval)
+                if args.watch:
+                    print(_watch_line(collector.health()), flush=True)
+        except KeyboardInterrupt:
+            pass
+        finally:
+            collector.stop()
+            if httpd is not None:
+                httpd.shutdown()
+        return 0
+
+    doc = None
+    for i in range(max(args.scrapes, 1)):
+        if i:
+            time.sleep(args.interval)
+        doc = collector.scrape_once()
+    if args.json:
+        print(json.dumps(doc, indent=1, sort_keys=True, default=str))
+    else:
+        print(render(doc))
+    return _exit_code(doc)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -50,11 +50,18 @@ def spawn(
     slow_trace: float | None = None,
     rpc_timeout: float | None = None,
     chaos_seed: int | None = None,
+    fleet: int = 0,
+    fleet_interval: float = 2.0,
     extra_env: dict | None = None,
 ) -> list[subprocess.Popen]:
     """``verify_sidecar``: "auto" spawns one shared sidecar process and
     routes every daemon's verification through it (public data only —
     signing stays per-replica); "host:port" uses an existing one."""
+    if fleet and not api_base:
+        # Argument-only precondition: checked BEFORE any daemon spawns
+        # (raising mid-spawn would orphan the just-launched fleet).
+        raise ValueError("--fleet needs --api-base (it scrapes the "
+                         "daemon APIs)")
     os.makedirs(db_root, exist_ok=True)
     procs = []
     env = dict(os.environ, **(extra_env or {}))
@@ -108,6 +115,23 @@ def spawn(
             # to run but the fleet does not fire faults in lockstep.
             cmd += ["--chaos-seed", str(chaos_seed + i)]
         procs.append(subprocess.Popen(cmd, env=env))
+    if fleet:
+        # The health plane rides alongside the fleet: one collector
+        # process scraping every daemon's /info + /metrics + /trace,
+        # serving the aggregate on /fleet (bftkv_tpu.obs).
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "bftkv_tpu.cmd.fleet",
+                    "--api-base", str(api_base),
+                    "--count", str(len(homes)),
+                    "--api-host", api_host,
+                    "--listen", f"127.0.0.1:{fleet}",
+                    "--interval", str(fleet_interval),
+                ],
+                env=env,
+            )
+        )
     return procs
 
 
@@ -158,6 +182,15 @@ def main(argv: list[str] | None = None) -> int:
                          "failpoint registry (daemon i gets seed N+i); "
                          "same N replays the same fleet-wide fault "
                          "schedule (see bftkv --help)")
+    ap.add_argument("--fleet", type=int, default=0, metavar="PORT",
+                    help="boot the fleet health collector alongside the "
+                         "cluster, serving /fleet (JSON + Prometheus) on "
+                         "127.0.0.1:PORT — per-shard f-budget, stitched "
+                         "cross-process traces, anomaly feed "
+                         "(bftkv_tpu.obs; needs --api-base)")
+    ap.add_argument("--fleet-interval", type=float, default=2.0,
+                    metavar="SECONDS",
+                    help="collector scrape interval")
     ap.add_argument("--shards", type=int, default=0, metavar="N",
                     help="one-box sharded quickstart: when --keys holds "
                          "no server homes yet, generate an N-clique "
@@ -182,6 +215,10 @@ def main(argv: list[str] | None = None) -> int:
     if not homes:
         print(f"no server homes under {args.keys}", file=sys.stderr)
         return 1
+    if args.fleet and not args.api_base:
+        print("--fleet needs --api-base (the collector scrapes the "
+              "daemon APIs)", file=sys.stderr)
+        return 1
     procs = spawn(homes, args.db_root, storage=args.storage,
                   api_base=args.api_base, api_host=args.api_host,
                   bind_host=args.bind_host, client_home=args.client_home,
@@ -189,7 +226,11 @@ def main(argv: list[str] | None = None) -> int:
                   anti_entropy=args.anti_entropy,
                   slow_trace=args.slow_trace,
                   rpc_timeout=args.rpc_timeout,
-                  chaos_seed=args.chaos_seed)
+                  chaos_seed=args.chaos_seed,
+                  fleet=args.fleet, fleet_interval=args.fleet_interval)
+    if args.fleet:
+        print(f"run_cluster: fleet health @ http://127.0.0.1:{args.fleet}"
+              "/fleet", flush=True)
     # The sidecar (if spawned, always first) is an optional optimizer
     # whose clients fall back to local verification: its death must not
     # tear down the replica fleet, and it is not a "server".

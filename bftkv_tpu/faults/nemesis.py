@@ -69,18 +69,35 @@ class Nemesis:
         self.registry = registry or fp.registry
         self._written: dict[bytes, bytes] = {}
         self.failures = {"write": 0, "read": 0}
+        #: Fleet health collector watching the same cluster — the chaos
+        #: suite double-checks the *observability plane*: every injected
+        #: fault must surface in the anomaly feed within one scrape of
+        #: its window (built in :meth:`run`; None = detection off).
+        self.collector = None
+        self.detection: list[dict] = []
 
     # -- deterministic planning -------------------------------------------
 
     def plan(self, steps: int = 4) -> list[dict]:
         """Pure function of (seed, cluster shape): the schedule replays
-        identically run to run."""
+        identically run to run.
+
+        ``stale_replay`` targets only the storage plane: single reads
+        fan out to the read complement ``R = {Vi} − {Ci}`` (wotqs), so
+        a read-replayer programmed onto a *quorum* server would never
+        receive a read — a fault that cannot manifest exercises
+        nothing and is undetectable by construction."""
         rng = random.Random(self.seed)
         targets = sorted(self.cluster.names(storage_only=True))
+        uni = getattr(self.cluster, "universe", None)
+        storage = sorted(
+            i.name for i in getattr(uni, "storage_nodes", ())
+        ) or targets
         out = []
         for i in range(steps):
             kind = STEP_KINDS[rng.randrange(len(STEP_KINDS))]
-            step = {"step": i, "kind": kind, "target": targets[rng.randrange(len(targets))]}
+            pool = storage if kind == "stale_replay" else targets
+            step = {"step": i, "kind": kind, "target": pool[rng.randrange(len(pool))]}
             if kind == "clock_skew":
                 step["delta"] = rng.choice([-1000, 1000, 1 << 20])
             elif kind == "link_delay":
@@ -144,31 +161,78 @@ class Nemesis:
 
     def traffic(self, tag: str, writes: int = 3, reads: int = 3) -> None:
         """A burst of recorded writes + reads.  Failures are counted,
-        not raised: under a partition failing is correct behavior."""
+        not raised: under a partition failing is correct behavior.
+
+        Sharded clusters get COVERAGE traffic on top of the base burst:
+        at least one write and one read per shard each window.  A fault
+        on a replica only *manifests* when traffic crosses it (a
+        partition rule fires on a cut send, a Byzantine handler on an
+        arriving request) — without coverage, a window whose random
+        keys all routed elsewhere would leave the fault invisible to
+        both the checker and the detection assertion."""
         rec = self.cluster.recorder
         cl = self._client(0)
         cname = "u01"
         for i in range(writes):
             var = f"chaos/{tag}/{i}".encode()
             val = f"value-{tag}-{i}".encode()
-            try:
-                cl.write(var, val)
-                rec.write_ok(cname, var, val)
-                self._written[var] = val
-            except Exception as e:
-                rec.write_fail(cname, var, e)
-                self.failures["write"] += 1
+            self._write_one(cl, rec, cname, var, val)
+        shard_of = getattr(cl.qs, "shard_of", None)
+        nsh = (
+            cl.qs.shard_count()
+            if hasattr(cl.qs, "shard_count")
+            else 1
+        )
+        if shard_of is not None and nsh > 1:
+            covered = {
+                shard_of(f"chaos/{tag}/{i}".encode())
+                for i in range(writes)
+            }
+            i = 0
+            while len(covered) < nsh and i < 4096:
+                var = f"chaos/{tag}/cover/{i}".encode()
+                i += 1
+                s = shard_of(var)
+                if s in covered:
+                    continue
+                covered.add(s)
+                self._write_one(
+                    cl, rec, cname, var, f"cover-{tag}".encode()
+                )
         # str seeds hash via sha512 (deterministic); a tuple seed would
         # go through PYTHONHASHSEED-salted hash() and break replay.
         rng = random.Random(f"{self.seed}|{tag}")
         candidates = sorted(self._written)
-        for _ in range(min(reads, len(candidates))):
-            var = candidates[rng.randrange(len(candidates))]
+        picks = [
+            candidates[rng.randrange(len(candidates))]
+            for _ in range(min(reads, len(candidates)))
+        ]
+        if shard_of is not None and nsh > 1:
+            # One read per shard (newest written var of each), so a
+            # read-path fault (stale replayer) sees traffic too.
+            per_shard: dict = {}
+            for var in candidates:
+                per_shard[shard_of(var)] = var
+            picks += [
+                v
+                for s, v in sorted(per_shard.items())
+                if not any(shard_of(p) == s for p in picks)
+            ]
+        for var in picks:
             try:
                 rec.read_ok(cname, var, cl.read(var))
             except Exception as e:
                 rec.read_fail(cname, var, e)
                 self.failures["read"] += 1
+
+    def _write_one(self, cl, rec, cname: str, var: bytes, val: bytes) -> None:
+        try:
+            cl.write(var, val)
+            rec.write_ok(cname, var, val)
+            self._written[var] = val
+        except Exception as e:
+            rec.write_fail(cname, var, e)
+            self.failures["write"] += 1
 
     # -- convergence -------------------------------------------------------
 
@@ -212,15 +276,89 @@ class Nemesis:
                     pass
         return converged()
 
+    # -- detection (the observability plane under test) --------------------
+
+    def _make_collector(self):
+        from bftkv_tpu import trace as trmod
+        from bftkv_tpu.metrics import registry as mreg
+        from bftkv_tpu.obs import FleetCollector, LocalSource
+
+        sources = [
+            # server_named resolves through _by_name, so a source keeps
+            # following its member across crash-restarts.
+            LocalSource(name, lambda n=name: self.cluster.server_named(n))
+            for name in sorted(self.cluster._by_name)
+        ]
+        return FleetCollector(
+            sources,
+            local_metrics=mreg,
+            local_tracer=trmod.tracer,
+            fp_registry=self.registry,
+        )
+
+    def _observe_window(self, step: dict, seq0: int) -> None:
+        """Scrape INSIDE the fault window, then the assertion that
+        makes chaos a test of the health plane: the injected fault must
+        be in the anomaly feed within one scrape interval.  The
+        multicast fan-out abandons stragglers at the quorum threshold,
+        so the window's last RPC — the one that trips the rule on the
+        target — may still be in flight when traffic() returns; the
+        bounded re-scrape below IS the "one interval" allowance, and
+        the fault stays armed throughout."""
+        if self.collector is None:
+            return
+        kind, target = step["kind"], step["target"]
+
+        def hit() -> bool:
+            fresh = self.collector.anomalies(since_seq=seq0)
+            if kind == "crash_restart":
+                # The plane "sees" an outage either as a fresh
+                # member_down transition or as the member simply BEING
+                # down at scrape time — consecutive crash windows on
+                # one target never transition back to up in between,
+                # so the transition alone would under-report.
+                m = self.collector.members.get(target)
+                if m is not None and m.status == "down":
+                    return True
+                return any(
+                    a["kind"] == "member_down" and a["source"] == target
+                    for a in fresh
+                )
+            return any(
+                a["kind"] == "fault" and a["source"] == target
+                for a in fresh
+            )
+
+        detected = False
+        # Generous tail (~6 s worst case, first scrape usually wins):
+        # under 2-CPU contention an abandoned straggler post — the one
+        # carrying the only RPC that trips the rule on the target — can
+        # sit queued behind the writers for whole seconds.
+        for attempt in range(24):
+            if attempt:
+                time.sleep(0.25)
+            self.collector.scrape_once()
+            if hit():
+                detected = True
+                break
+        self.detection.append(
+            {"step": step["step"], "kind": kind, "target": target,
+             "detected": detected}
+        )
+
     # -- one full run ------------------------------------------------------
 
     def run_step(self, step: dict, dwell: float = 0.0) -> None:
         kind, target = step["kind"], step["target"]
         tag = f"s{step['step']}-{kind}"
+        seq0 = (
+            self.collector._anomaly_seq if self.collector is not None else 0
+        )
         if kind == "partition":
             rules = self.partition(target)
             try:
                 self.traffic(tag)
+                self._observe_window(step, seq0)
                 if dwell:
                     time.sleep(dwell)
             finally:
@@ -229,6 +367,7 @@ class Nemesis:
             self.cluster.crash(target)
             try:
                 self.traffic(tag)
+                self._observe_window(step, seq0)
                 if dwell:
                     time.sleep(dwell)
             finally:
@@ -237,39 +376,54 @@ class Nemesis:
             rules = self.clock_skew(target, step["delta"])
             try:
                 self.traffic(tag)
+                self._observe_window(step, seq0)
             finally:
                 self.heal(rules)
         elif kind == "link_delay":
             rules = self.link_delay(target, step["seconds"])
             try:
                 self.traffic(tag)
+                self._observe_window(step, seq0)
             finally:
                 self.heal(rules)
         elif kind == "stale_replay":
             rules = byzantine.make_stale_replayer(self.registry, target)
             try:
                 self.traffic(tag)
+                self._observe_window(step, seq0)
             finally:
                 self.registry.remove_all(rules)
         elif kind == "collude":
             rules = byzantine.make_colluder(self.registry, target)
             try:
                 self.traffic(tag)
+                self._observe_window(step, seq0)
             finally:
                 self.registry.remove_all(rules)
         else:  # pragma: no cover
             raise ValueError(f"unknown step kind {kind!r}")
 
-    def run(self, steps: int = 4, dwell: float = 0.0) -> dict:
+    def run(
+        self, steps: int = 4, dwell: float = 0.0, detect: bool = True
+    ) -> dict:
         """Arm, execute the seeded plan with traffic, repair, check.
-        Returns a report dict (``violations`` empty = safe run)."""
+        Returns a report dict (``violations`` empty = safe run;
+        ``undetected`` empty = every fault surfaced in the health
+        plane's anomaly feed within its own window)."""
         plan = self.plan(steps)
         # Shard layout before the run: if it survives unchanged (no
         # membership churn rerouted the keyspace), the checker may apply
         # the strict one-shard-per-variable invariant.
         shard_map_before = self.cluster.shard_map()
         self.registry.arm(self.seed)
+        self.detection = []  # a re-run must not inherit stale verdicts
+        self.collector = self._make_collector() if detect else None
         try:
+            if self.collector is not None:
+                # Baseline scrape: counter-delta anomalies measure from
+                # here, and every member's shard seat is on file before
+                # the first fault lands.
+                self.collector.scrape_once()
             cl = self._client(0)
             once_var, once_val = b"chaos/once", b"immutable"
             cl.write_once(once_var, once_val)
@@ -286,6 +440,10 @@ class Nemesis:
                 self.cluster.recorder.read_fail("u01", once_var, e)
             converged = self.converge()
             trace = self.registry.trace()
+            if self.collector is not None:
+                # Post-repair scrape: restarted members flip back to up
+                # (member_up anomalies close the windows).
+                self.collector.scrape_once()
         finally:
             self.registry.disarm()
         shard_map = self.cluster.shard_map()
@@ -306,6 +464,13 @@ class Nemesis:
             "fault_trace": [list(e) for e in trace[:200]],
             "failures": dict(self.failures),
             "violations": violations,
+            "detection": self.detection,
+            "undetected": [d for d in self.detection if not d["detected"]],
+            "anomalies": (
+                len(self.collector.anomalies())
+                if self.collector is not None
+                else None
+            ),
         }
 
 
@@ -331,6 +496,9 @@ def main(argv: list[str] | None = None) -> int:
                     help="extra seconds to hold each fault window open")
     ap.add_argument("--json", action="store_true",
                     help="print the full report as JSON")
+    ap.add_argument("--no-detect", action="store_true",
+                    help="skip the fleet-collector detection assertion "
+                         "(safety checking only)")
     args = ap.parse_args(argv)
 
     cluster = build_cluster(
@@ -338,28 +506,46 @@ def main(argv: list[str] | None = None) -> int:
     )
     try:
         report = Nemesis(cluster, seed=args.seed).run(
-            steps=args.steps, dwell=args.dwell
+            steps=args.steps, dwell=args.dwell, detect=not args.no_detect
         )
     finally:
         cluster.stop()
+    failed = bool(
+        report["violations"]
+        or not report["converged"]
+        or report["undetected"]
+    )
     if args.json:
         print(json.dumps(report, indent=2, default=repr))
-        return 1 if report["violations"] or not report["converged"] else 0
+        return 1 if failed else 0
+    detected = [d for d in report["detection"] if d["detected"]]
     print(
         f"nemesis seed={report['seed']} shards={report['shards']} "
         f"steps={len(report['plan'])} "
         f"faults_fired={report['faults_fired']} "
-        f"failures={report['failures']} converged={report['converged']}"
+        f"failures={report['failures']} converged={report['converged']} "
+        f"detected={len(detected)}/{len(report['detection'])}"
     )
     for v in report["violations"]:
         print(f"VIOLATION: {v}")
+    for d in report["undetected"]:
+        print(
+            f"UNDETECTED: step {d['step']} {d['kind']} on {d['target']} "
+            "never surfaced in the health feed"
+        )
     if report["violations"]:
         print("nemesis: SAFETY VIOLATIONS FOUND")
         return 1
     if not report["converged"]:
         print("nemesis: replicas did not converge")
         return 1
-    print("nemesis: ok (zero safety violations)")
+    if report["undetected"]:
+        print("nemesis: FAULTS INVISIBLE TO THE HEALTH PLANE")
+        return 1
+    print(
+        "nemesis: ok (zero safety violations; every fault window "
+        "visible in the health feed)"
+    )
     return 0
 
 

@@ -7,16 +7,26 @@ the benchmark harness read.  Deliberately dependency-free and cheap:
 one lock, plain dicts, snapshot on demand.
 
 Every instrument takes optional ``labels`` (a small dict of low-
-cardinality dimensions — command names, transport kind, never
-variables or peer addresses; cardinality rules in docs/DESIGN.md §7).
-Two export surfaces:
+cardinality dimensions — command names, transport kind, shard indices,
+never variables or peer addresses; cardinality rules in
+docs/DESIGN.md §7).  Two export surfaces:
 
 - :meth:`Metrics.snapshot` — the historical flat JSON dict; labeled
   series flatten to ``name{k=v,...}`` keys, unlabeled keys are
-  unchanged so existing consumers keep working;
+  unchanged so existing consumers keep working.  Each ``observe()``
+  series additionally exports its fixed-bucket counts as
+  ``name.bucket{le=...}`` keys;
 - :meth:`Metrics.prometheus` — Prometheus text exposition (0.0.4):
   counters as ``bftkv_<name>_total``, gauges as ``bftkv_<name>``,
-  ``observe()`` series as summaries (``_count``/``_sum`` + quantiles).
+  ``observe()`` series as **histograms** (``_bucket{le=...}`` +
+  ``_count``/``_sum``).
+
+Histograms, not summaries: every daemon uses the same fixed bucket
+bounds (:data:`BUCKETS`), so a fleet collector can sum bucket counts
+across processes and compute fleet-wide quantile estimates — per-daemon
+summary quantiles cannot be merged at all (the p99 of a set of p99s is
+meaningless).  The in-process percentile()/snapshot p50/p99 keys stay
+sample-exact for single-process consumers (bench.py).
 """
 
 from __future__ import annotations
@@ -26,7 +36,48 @@ import threading
 import time
 from collections import defaultdict
 
-__all__ = ["Metrics", "registry"]
+__all__ = ["BUCKETS", "Metrics", "histogram_quantile", "registry"]
+
+#: Fixed histogram bucket upper bounds, IDENTICAL in every process so
+#: bucket counts sum across daemons.  The low end covers RPC/crypto
+#: latencies (seconds), the high end covers the other observe() users
+#: (batch sizes, items/s) coarsely — a count landing past 60 falls into
+#: the wide tail buckets and the +Inf overflow.  Changing these bounds
+#: is a fleet-wide flag day: collector merges require equal ladders.
+BUCKETS: tuple = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0, 1000.0, 100000.0,
+)
+
+
+def histogram_quantile(q: float, buckets: list[int] | tuple) -> float | None:
+    """Quantile estimate from per-bucket counts (len(BUCKETS)+1, the
+    last being +Inf overflow): the upper bound of the bucket holding
+    the q-th sample.  None on an empty histogram.  This is the merge
+    side of the fixed-ladder design — sum per-daemon bucket vectors,
+    then call this."""
+    total = sum(buckets)
+    if total <= 0:
+        return None
+    rank = q * total
+    acc = 0
+    for i, c in enumerate(buckets):
+        acc += c
+        if acc > rank or acc >= total:
+            return BUCKETS[i] if i < len(BUCKETS) else float("inf")
+    return float("inf")  # pragma: no cover
+
+
+def _bucket_index(value: float) -> int:
+    lo, hi = 0, len(BUCKETS)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if value <= BUCKETS[mid]:
+            hi = mid
+        else:
+            lo = mid + 1
+    return lo  # == len(BUCKETS) -> +Inf overflow
+
 
 #: Label sets are stored as sorted (key, value) tuples; () = unlabeled.
 _NO_LABELS: tuple = ()
@@ -88,6 +139,12 @@ class Metrics:
         self._counts: dict[tuple, int] = defaultdict(int)
         self._sums: dict[tuple, float] = defaultdict(float)
         self._samples: dict[tuple, list[float]] = defaultdict(list)
+        # Fixed-bucket counts per observe() series (len(BUCKETS)+1; the
+        # last slot is the +Inf overflow).  Unlike the sample ring these
+        # cover the WHOLE run and merge across processes by summation.
+        self._buckets: dict[tuple, list[int]] = defaultdict(
+            lambda: [0] * (len(BUCKETS) + 1)
+        )
         # Ring-buffer write cursors: the histogram must keep admitting
         # values forever.  The old append-until-full behavior froze each
         # series at its first 65536 samples, so a daemon's p50/p99
@@ -137,6 +194,7 @@ class Metrics:
         with self._lock:
             self._counts[k] += 1
             self._sums[k] += value
+            self._buckets[k][_bucket_index(value)] += 1
             s = self._samples[k]
             if len(s) < self._max_samples:
                 s.append(value)
@@ -185,6 +243,7 @@ class Metrics:
             counts = dict(self._counts)
             sums = dict(self._sums)
             series = {k: list(s) for k, s in self._samples.items() if s}
+            buckets = {k: list(b) for k, b in self._buckets.items()}
         out: dict = {}
         for (name, labels), v in counters.items():
             out[_flat(name, labels)] = v
@@ -200,20 +259,55 @@ class Metrics:
                 out[_flat(f"{name}.{tag}", labels)] = s[
                     min(len(s) - 1, int(q * len(s)))
                 ]
+        # Fixed-bucket counts, one flat key per non-empty bucket (the
+        # collector's merge input; empty buckets are elided to keep the
+        # snapshot small).  ``le`` joins the series' own labels so the
+        # key parses with the same name{k=v,...} grammar.
+        for (name, labels), b in buckets.items():
+            for i, c in enumerate(b):
+                if not c:
+                    continue
+                le = BUCKETS[i] if i < len(BUCKETS) else "+Inf"
+                out[
+                    _flat(f"{name}.bucket", labels + (("le", le),))
+                ] = c
         return out
+
+    def histograms(self) -> dict:
+        """Structured fixed-bucket export: flat series key →
+        ``{"count", "sum", "buckets"}`` with ``buckets`` the raw
+        per-bucket counts (len(BUCKETS)+1, last = +Inf overflow).
+        In-process convenience view; the fleet collector itself merges
+        from the snapshot's ``name.bucket{le=}`` flat keys, since that
+        is the only form that crosses the daemon ``/metrics`` wire."""
+        with self._lock:
+            counts = dict(self._counts)
+            sums = dict(self._sums)
+            buckets = {k: list(b) for k, b in self._buckets.items()}
+        return {
+            _flat(name, labels): {
+                "count": counts.get((name, labels), 0),
+                "sum": sums.get((name, labels), 0.0),
+                "buckets": b,
+            }
+            for (name, labels), b in buckets.items()
+        }
 
     def prometheus(self) -> str:
         """Prometheus text exposition, format 0.0.4.
 
         Counter names end in ``_total``; ``observe()`` series render as
-        summaries (``{quantile="..."}`` samples over the recent window,
-        ``_sum``/``_count`` over the whole run); gauges are plain."""
+        fixed-bucket HISTOGRAMS (cumulative ``_bucket{le="..."}`` +
+        ``_sum``/``_count`` over the whole run) so any scraper — and
+        the fleet collector — can aggregate latency across daemons;
+        gauges are plain.  (Summaries were the original exposition;
+        per-daemon quantiles cannot be merged, DESIGN.md §11.)"""
         counters = self._counter_totals()
         with self._lock:
             gauges = dict(self._gauges)
             counts = dict(self._counts)
             sums = dict(self._sums)
-            series = {k: list(s) for k, s in self._samples.items() if s}
+            series = {k: list(b) for k, b in self._buckets.items()}
 
         lines: list[str] = []
 
@@ -237,14 +331,15 @@ class Metrics:
 
         for name, rows in sorted(by_name(series).items()):
             pn = _prom_name(name)
-            lines.append(f"# TYPE {pn} summary")
-            for labels, s in sorted(rows):
-                s.sort()
-                for q in (0.5, 0.9, 0.99):
-                    v = s[min(len(s) - 1, int(q * len(s)))]
+            lines.append(f"# TYPE {pn} histogram")
+            for labels, b in sorted(rows):
+                acc = 0
+                for i, c in enumerate(b):
+                    acc += c
+                    le = BUCKETS[i] if i < len(BUCKETS) else "+Inf"
                     lines.append(
-                        f"{pn}{_prom_labels(labels, (('quantile', q),))}"
-                        f" {_prom_value(v)}"
+                        f"{pn}_bucket{_prom_labels(labels, (('le', le),))}"
+                        f" {acc}"
                     )
                 key = (name, labels)
                 lines.append(
@@ -266,6 +361,7 @@ class Metrics:
             self._sums.clear()
             self._samples.clear()
             self._sample_pos.clear()
+            self._buckets.clear()
         for d in shards:
             d.clear()
 

@@ -1,0 +1,38 @@
+"""Fleet health plane: cross-process observability for a sharded fleet.
+
+PR 2 gave each daemon its own ``/metrics`` and ``/trace`` rings; PR 6
+split the fleet into disjoint quorum cliques.  After that, no single
+component could answer the question the paper's safety story makes
+quantitative: *how close is shard k to losing liveness or safety right
+now* — a quorum survives only while failures stay under
+``f = (n-1)/3``, and that margin was invisible.
+
+This package is the aggregation side (the shape Thetacrypt proves out:
+a co-located service multiplexing many replicas is only operable with
+a shared observability plane):
+
+- :mod:`bftkv_tpu.obs.source` — where fleet state comes from: one
+  :class:`~bftkv_tpu.obs.source.HTTPSource` per daemon API (scrapes
+  ``/info`` + ``/metrics`` + ``/trace?since=``), or
+  :class:`~bftkv_tpu.obs.source.LocalSource` for in-process clusters
+  (the chaos harness);
+- :mod:`bftkv_tpu.obs.stitch` — joins every process's exported spans
+  into one tree per trace id, so a single client write reads as one
+  story across client, quorum, and storage processes;
+- :mod:`bftkv_tpu.obs.collector` — the
+  :class:`~bftkv_tpu.obs.collector.FleetCollector`: per-shard
+  **f-budget** against the ``quorum/wotqs.py`` thresholds, merged
+  fixed-bucket SLO histograms with slow-trace exemplars, and an
+  anomaly feed (counter deltas, membership transitions, failpoint
+  events);
+- :mod:`bftkv_tpu.obs.http` — ``/fleet`` as JSON and Prometheus text.
+
+Entry points: ``python -m bftkv_tpu.cmd.fleet`` (one-shot, ``--watch``,
+``--listen``) and ``run_cluster --fleet``.  Design: docs/DESIGN.md §11.
+"""
+
+from bftkv_tpu.obs.collector import FleetCollector
+from bftkv_tpu.obs.source import HTTPSource, LocalSource
+from bftkv_tpu.obs.stitch import Stitcher
+
+__all__ = ["FleetCollector", "HTTPSource", "LocalSource", "Stitcher"]

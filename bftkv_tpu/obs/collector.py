@@ -1,0 +1,618 @@
+"""The fleet collector: scrape → stitch → BFT-native health.
+
+One :class:`FleetCollector` watches every member of a (possibly
+sharded) fleet through :mod:`bftkv_tpu.obs.source` objects and keeps
+three products current:
+
+**f-budget per shard.**  The paper's tolerance is quantitative: a
+clique of ``n`` replicas survives ``f = (n-1)//3`` faults, commits at
+``2f+1`` and collects signatures at ``suff = f + (n-f)//2 + 1``
+(``quorum/wotqs.py``).  The collector counts clique members that fail
+their liveness probe and reports ``remaining = f - down`` — the number
+of additional faults the shard can absorb before its write quorum
+stalls (liveness) or its masking assumption breaks (safety).  Storage
+(complement) members are tracked and alarmed but do not consume the
+clique budget: the WRITE complement runs with ``f = 0`` by
+construction (wotqs ``W = U − {Ci} + R``).
+
+**SLO histograms per shard.**  Daemons export fixed-bucket latency
+histograms (``metrics.BUCKETS``) precisely so this code can sum bucket
+vectors across processes and estimate fleet-wide p50/p99 — per-daemon
+summary quantiles cannot be merged.  Slow-trace entries (which carry
+``shard``/``peer`` attribution) become exemplars: a latency regression
+links directly to trace ids you can pull.
+
+**Anomaly feed.**  A bounded ring of events derived from what already
+exists: per-source counter deltas (``server.wrong_shard``,
+``server.equivocation``, ``server.verify.collective_fail``,
+``transport.peer.opens``, ``faults.fired``), membership transitions
+(probe up→down / down→up), and — in-process — the failpoint
+registry's fault trace, so an injected partition surfaces in the feed
+within one scrape interval (the chaos nemesis asserts exactly this).
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+import time
+from collections import deque
+
+from bftkv_tpu.metrics import BUCKETS, histogram_quantile
+from bftkv_tpu.obs.stitch import Stitcher
+
+__all__ = ["FleetCollector", "parse_flat_key"]
+
+_FLAT_KEY = re.compile(r"^([^{]+)\{(.*)\}$")
+
+#: Counter families whose per-scrape delta becomes one anomaly event.
+#: Closed list — the feed must not turn into a second metrics dump.
+ANOMALY_COUNTERS = {
+    "server.wrong_shard": "wrong_shard",
+    "server.equivocation": "equivocation",
+    "server.verify.collective_fail": "collective_verify_fail",
+    "transport.peer.opens": "peer_circuit_open",
+    "faults.fired": "fault_injected",
+}
+
+
+def parse_flat_key(key: str) -> tuple[str, dict]:
+    """``name{k=v,...}`` → ``(name, {k: v})`` (the snapshot grammar)."""
+    m = _FLAT_KEY.match(key)
+    if not m:
+        return key, {}
+    labels: dict = {}
+    for part in m.group(2).split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            labels[k] = v
+    return m.group(1), labels
+
+
+class _Member:
+    __slots__ = (
+        "source",
+        "info",
+        "info_stale",
+        "status",
+        "last_ok",
+        "last_err",
+        "scrape_s",
+        "cursor",
+        "prev_counters",
+    )
+
+    def __init__(self, source):
+        self.source = source
+        self.info: dict = {}
+        #: Re-fetch /info on the next scrape (set on recovery and on
+        #: the periodic refresh tick) — but keep the LAST KNOWN seat
+        #: meanwhile: a down member's f-budget attribution needs it.
+        self.info_stale = True
+        self.status = "unknown"  # unknown | up | down
+        self.last_ok = 0.0
+        self.last_err = ""
+        self.scrape_s = 0.0
+        self.cursor = 0
+        self.prev_counters: dict = {}
+
+
+class FleetCollector:
+    """``sources``: one per fleet member.  ``local_metrics`` /
+    ``local_tracer`` / ``fp_registry``: process-wide feeds for
+    in-process clusters (every loopback server shares one registry and
+    tracer, so these attach once, to the collector, not per source).
+    ``scrape_once()`` is synchronous and reentrant-safe;
+    ``start(interval)`` runs it on a daemon thread."""
+
+    #: Every member's /info (shard seat, clique membership) is
+    #: re-fetched at this scrape cadence — and immediately after a
+    #: down→up transition — so membership churn reseats the health
+    #: document instead of going stale forever.
+    INFO_REFRESH_SCRAPES = 30
+
+    def __init__(
+        self,
+        sources: list,
+        *,
+        interval: float = 2.0,
+        local_metrics=None,
+        local_tracer=None,
+        fp_registry=None,
+        max_anomalies: int = 1024,
+    ):
+        self.members = {s.name: _Member(s) for s in sources}
+        self.interval = interval
+        self.local_metrics = local_metrics
+        self.local_tracer = local_tracer
+        self.fp_registry = fp_registry
+        self.stitcher = Stitcher()
+        self._lock = threading.Lock()
+        self._anomalies: deque = deque(maxlen=max_anomalies)
+        self._anomaly_seq = 0
+        self._local_cursor = 0
+        self._local_prev: dict = {}
+        self._fp_seq = 0
+        self._scrapes = 0
+        self._slo: dict = {}  # (shard, op) -> merged bucket vector
+        self._slo_sums: dict = {}  # (shard, op) -> merged latency sum
+        self._exemplars: dict = {}  # shard -> deque of slow entries
+        self._thread: threading.Thread | None = None
+        self._stop = threading.Event()
+
+    # -- anomaly feed ------------------------------------------------------
+
+    def _emit(self, kind: str, source: str, shard, detail: str, count=1):
+        with self._lock:
+            self._anomaly_seq += 1
+            self._anomalies.append(
+                {
+                    "seq": self._anomaly_seq,
+                    "ts": time.time(),
+                    "kind": kind,
+                    "source": source,
+                    "shard": shard,
+                    "detail": detail,
+                    "count": count,
+                }
+            )
+
+    def anomalies(self, since_seq: int = 0, limit: int = 200) -> list[dict]:
+        with self._lock:
+            return [a for a in self._anomalies if a["seq"] > since_seq][
+                -limit:
+            ]
+
+    # -- scraping ----------------------------------------------------------
+
+    def _shard_of_member(self, name: str):
+        m = self.members.get(name)
+        if m is None:
+            return None
+        return m.info.get("shard")
+
+    def _counter_deltas(self, who: str, shard, prev: dict, snap: dict) -> dict:
+        """Diff the watched counter families and emit anomalies; returns
+        the new baseline (watched keys only)."""
+        base: dict = {}
+        for key, val in snap.items():
+            if not isinstance(val, (int, float)):
+                continue
+            name, labels = parse_flat_key(key)
+            kind = ANOMALY_COUNTERS.get(name)
+            if kind is None:
+                continue
+            base[key] = val
+            delta = val - prev.get(key, 0)
+            if delta > 0:
+                detail = (
+                    ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+                    or name
+                )
+                sh = labels.get("shard")
+                if sh is not None and sh.isdigit():
+                    sh = int(sh)
+                else:
+                    sh = shard
+                self._emit(kind, who, sh, detail, int(delta))
+        return base
+
+    _SLO_OPS = {
+        "client.write.latency": "write",
+        "client.read.latency": "read",
+    }
+
+    def _merge_slo(self, shard_counts: dict, shard_sums: dict,
+                   snap: dict) -> None:
+        """Fold one daemon's ``client.{write,read}.latency`` bucket and
+        sum keys into the per-shard merged histograms.  Shard-labeled
+        series win; unlabeled series only count when the fleet is
+        unsharded (they would double-count otherwise — the client
+        observes both)."""
+        sharded = any(
+            (m.info.get("shard_count") or 1) > 1
+            for m in self.members.values()
+        )
+        for key, val in snap.items():
+            name, labels = parse_flat_key(key)
+            if name.endswith(".bucket"):
+                op = self._SLO_OPS.get(name[: -len(".bucket")])
+                kind = "bucket"
+            elif name.endswith(".sum"):
+                op = self._SLO_OPS.get(name[: -len(".sum")])
+                kind = "sum"
+            else:
+                continue
+            if op is None:
+                continue
+            sh = labels.get("shard")
+            if sh is None:
+                if sharded:
+                    continue
+                sh = 0
+            else:
+                sh = int(sh) if str(sh).isdigit() else sh
+            if kind == "sum":
+                shard_sums[(sh, op)] = shard_sums.get((sh, op), 0.0) + val
+                continue
+            le = labels.get("le")
+            try:
+                idx = (
+                    len(BUCKETS)
+                    if le == "+Inf"
+                    else BUCKETS.index(float(le))
+                )
+            except (TypeError, ValueError):
+                continue
+            h = shard_counts.setdefault(
+                (sh, op), [0] * (len(BUCKETS) + 1)
+            )
+            h[idx] += int(val)
+
+    def _ingest_slow(self, who: str, shard, slow: list) -> None:
+        for entry in slow or []:
+            sh = entry.get("shard")
+            if sh is None:
+                sh = shard
+            if sh is None:
+                sh = 0  # unsharded fleets report as shard 0 throughout
+            ex = {
+                "trace_id": entry.get("trace_id"),
+                "root": entry.get("root"),
+                "duration": round(entry.get("duration", 0.0), 4),
+                "source": who,
+            }
+            if "peer" in entry:
+                ex["peer"] = entry["peer"]
+            with self._lock:  # health() iterates these concurrently
+                d = self._exemplars.setdefault(sh, deque(maxlen=16))
+                if not any(
+                    e["trace_id"] == ex["trace_id"] for e in d
+                ):
+                    d.append(ex)
+
+    def _fetch(self, m: _Member) -> tuple:
+        """The NETWORK phase for one member — no shared-state writes,
+        so many of these run concurrently (a hung daemon then costs one
+        source-timeout of wall clock per scrape, not one per hung
+        member serially).  Returns
+        ``(info|None, ok, snap, texp, err, elapsed_s)``."""
+        t0 = time.perf_counter()
+        info = None
+        try:
+            if m.info_stale or not m.info:
+                info = m.source.info() or {}
+            if not getattr(m.source, "PROBE_BY_SCRAPE", False):
+                # In-process sources: the probe is the signal (their
+                # metrics feed is process-wide, always "up").
+                if not m.source.probe():
+                    return (info, False, None, None, "probe failed",
+                            time.perf_counter() - t0)
+            # HTTP sources skip the extra probe round trip: the
+            # metrics fetch succeeding IS the liveness signal.
+            snap = m.source.metrics()
+            texp = m.source.trace_export(m.cursor)
+            return info, True, snap, texp, "", time.perf_counter() - t0
+        except Exception as e:
+            return (info, False, None, None,
+                    str(e) or type(e).__name__,
+                    time.perf_counter() - t0)
+
+    def scrape_once(self) -> dict:
+        """One pass over every source + the process-wide feeds.
+        Returns the fresh :meth:`health` document."""
+        slo_counts: dict = {}
+        slo_sums: dict = {}
+        renames: list[tuple[str, str]] = []
+        with self._lock:
+            members = list(self.members.items())
+            refresh_tick = self._scrapes % self.INFO_REFRESH_SCRAPES == 0
+        if refresh_tick:
+            # Topology is not static: /joining, /leaving, and
+            # revocations reseat members.  Mark every seat stale on a
+            # slow cadence so the health plane converges to membership
+            # changes instead of grouping by a boot-time snapshot
+            # forever.
+            for _n, m in members:
+                m.info_stale = True
+
+        # Phase 1 — network, concurrent per member.
+        results: dict = {}
+        if len(members) > 1:
+            def run(name, m):
+                results[name] = self._fetch(m)
+
+            threads = [
+                threading.Thread(target=run, args=(n, m), daemon=True)
+                for n, m in members
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        else:
+            results = {n: self._fetch(m) for n, m in members}
+
+        # Phase 2 — state, sequential (stitcher/deltas/anomalies).
+        for name, m in members:
+            info, ok, snap, texp, err, elapsed = results[name]
+            prev_status = m.status
+            if info is not None:
+                m.info = info
+                m.info_stale = False
+                reported = info.get("name")
+                if reported and reported != name:
+                    # An HTTPSource starts out named host:port; the
+                    # daemon's /info supplies the real member name —
+                    # which must match clique-member lists for the
+                    # f-budget attribution to line up.
+                    renames.append((name, reported))
+                    name = reported
+            if ok:
+                m.cursor = texp.get("cursor", m.cursor)
+                self.stitcher.add(name, texp.get("spans") or [])
+                shard = m.info.get("shard")
+                self._ingest_slow(name, shard, texp.get("slow"))
+                m.prev_counters = self._counter_deltas(
+                    name, shard, m.prev_counters, snap
+                )
+                self._merge_slo(slo_counts, slo_sums, snap)
+                m.status = "up"
+                m.last_ok = time.time()
+                m.last_err = ""
+            else:
+                m.status = "down"
+                m.last_err = err
+            m.scrape_s = elapsed
+            if prev_status in ("up", "unknown") and m.status == "down":
+                self._emit(
+                    "member_down", name, m.info.get("shard"), m.last_err
+                )
+            elif prev_status == "down" and m.status == "up":
+                # A restart may have come back with a different seat.
+                m.info_stale = True
+                self._emit("member_up", name, m.info.get("shard"), "")
+        with self._lock:
+            # Key mutation under the lock: /fleet handler threads read
+            # the dict concurrently via _members_snapshot().
+            for old, new in renames:
+                if new not in self.members:
+                    self.members[new] = self.members.pop(old)
+
+        # Process-wide feeds (in-process clusters).
+        if self.local_metrics is not None:
+            snap = self.local_metrics.snapshot()
+            self._local_prev = self._counter_deltas(
+                "process", None, self._local_prev, snap
+            )
+            self._merge_slo(slo_counts, slo_sums, snap)
+        if self.local_tracer is not None:
+            texp = self.local_tracer.export(self._local_cursor)
+            self._local_cursor = texp["cursor"]
+            self.stitcher.add("process", texp["spans"])
+            self._ingest_slow("process", None, self.local_tracer.slow())
+        if self.fp_registry is not None:
+            events = self.fp_registry.trace()
+            if events and events[-1].seq < self._fp_seq:
+                self._fp_seq = 0  # registry re-armed: sequence restarted
+            for ev in events:
+                if ev.seq <= self._fp_seq:
+                    continue
+                self._fp_seq = ev.seq
+                target = ev.rule_id.split(":", 1)[1].split(":", 1)[0] \
+                    if ":" in ev.rule_id else ""
+                self._emit(
+                    "fault",
+                    target or "?",
+                    self._shard_of_member(target),
+                    f"{ev.point}:{ev.rule_id}:{ev.kind}",
+                )
+
+        with self._lock:
+            if slo_counts:
+                self._slo = slo_counts
+                self._slo_sums = slo_sums
+            self._scrapes += 1
+        return self.health()
+
+    # -- health document ---------------------------------------------------
+
+    def _members_snapshot(self) -> dict:
+        """A consistent copy for reader threads — the scrape thread
+        renames keys (host:port → daemon name) under the same lock."""
+        with self._lock:
+            return dict(self.members)
+
+    def _shards(self, members: dict) -> dict:
+        """Group members by shard seat; daemons that reported an /info
+        WITHOUT a seat (unsharded storage nodes, degenerate graphs)
+        fold into shard 0 so the fleet is fully accounted for.  A
+        member that never answered /info at all is excluded here — its
+        seat is UNKNOWN, and binning it anywhere would let the shard
+        it really belongs to report a full f-budget while one of its
+        clique members is dark (health() surfaces these as
+        ``fleet.unseated`` instead)."""
+        shards: dict = {}
+        for name, m in members.items():
+            if not m.info:
+                continue
+            sh = m.info.get("shard")
+            sh = 0 if sh is None else sh
+            shards.setdefault(sh, []).append((name, m))
+        return shards
+
+    def health(self) -> dict:
+        shards_doc: dict = {}
+        now = time.time()
+        all_members = self._members_snapshot()
+        with self._lock:
+            slo = {k: list(v) for k, v in self._slo.items()}
+            slo_sums = dict(self._slo_sums)
+            exemplars = {k: list(v) for k, v in self._exemplars.items()}
+        for sh, members in sorted(
+            self._shards(all_members).items(), key=lambda kv: str(kv[0])
+        ):
+            clique = next(
+                (
+                    m.info["clique"]
+                    for _n, m in members
+                    if m.info.get("clique")
+                ),
+                None,
+            )
+            cnames = set(clique["members"]) if clique else {
+                n for n, _m in members
+            }
+            down = sorted(
+                n for n, m in members if m.status == "down"
+            )
+            clique_down = [n for n in down if n in cnames]
+            f = clique["f"] if clique else max((len(cnames) - 1) // 3, 0)
+            doc = {
+                "n": clique["n"] if clique else len(cnames),
+                "f": f,
+                "threshold": clique["threshold"] if clique else 2 * f + 1,
+                "suff": clique["suff"] if clique else None,
+                "members": [
+                    {
+                        "name": n,
+                        "role": m.info.get("role")
+                        or ("clique" if n in cnames else "storage"),
+                        "status": m.status,
+                        "scrape_s": round(m.scrape_s, 4),
+                        "last_ok_age_s": round(now - m.last_ok, 1)
+                        if m.last_ok
+                        else None,
+                    }
+                    for n, m in sorted(members)
+                ],
+                "f_budget": {
+                    "f": f,
+                    "used": len(clique_down),
+                    "remaining": f - len(clique_down),
+                    "down": clique_down,
+                    "storage_down": [n for n in down if n not in cnames],
+                },
+            }
+            slo_doc = {}
+            for op in ("write", "read"):
+                h = slo.get((sh, op))
+                if h and sum(h):
+                    slo_doc[op] = {
+                        "count": sum(h),
+                        "sum_s": round(slo_sums.get((sh, op), 0.0), 6),
+                        "p50_le_s": histogram_quantile(0.5, h),
+                        "p99_le_s": histogram_quantile(0.99, h),
+                        "buckets": h,
+                    }
+            doc["slo"] = slo_doc
+            doc["exemplars"] = exemplars.get(sh, [])
+            shards_doc[str(sh)] = doc
+
+        up = [n for n, m in all_members.items() if m.status == "up"]
+        with self._lock:
+            anomalies = list(self._anomalies)[-200:]
+            scrapes = self._scrapes
+        return {
+            "ts": now,
+            "scrapes": scrapes,
+            "interval_s": self.interval,
+            "fleet": {
+                "daemons": len(all_members),
+                "up": len(up),
+                "down": sorted(set(all_members) - set(up)),
+                # Seat unknown (never answered /info): every f-budget
+                # above is indeterminate while one of these is dark —
+                # the CLI exit code treats that as unhealthy.
+                "unseated": sorted(
+                    n for n, m in all_members.items() if not m.info
+                ),
+            },
+            "shards": shards_doc,
+            "traces": {
+                **self.stitcher.summary(),
+                "recent": self.stitcher.traces(limit=10),
+            },
+            "anomalies": anomalies,
+            "bucket_bounds": list(BUCKETS),
+        }
+
+    def prometheus(self) -> str:
+        """The fleet document as Prometheus text — gauges with a
+        ``shard`` label, counters for the anomaly feed.  Samples group
+        by family with exactly ONE ``# TYPE`` line each (a repeated
+        TYPE line for a name is a parse error in a real Prometheus
+        server, which would reject the whole exposition on any
+        multi-shard fleet)."""
+        doc = self.health()
+        order: list[str] = []  # family base names, first-seen order
+        types: dict[str, str] = {}
+        samples: dict[str, list[str]] = {}
+
+        def add(family: str, typ: str, suffix: str, sample: str):
+            base = "bftkv_fleet_" + family
+            if base not in types:
+                types[base] = typ
+                order.append(base)
+                samples[base] = []
+            samples[base].append(base + suffix + " " + sample)
+
+        add("daemons", "gauge", "", str(doc["fleet"]["daemons"]))
+        add("daemons_up", "gauge", "", str(doc["fleet"]["up"]))
+        add("scrapes", "gauge", "", str(doc["scrapes"]))
+        add("traces_stitched", "gauge", "",
+            str(doc["traces"]["stitched"]))
+        add("anomalies_total", "counter", "", str(self._anomaly_seq))
+        for sh, sd in sorted(doc["shards"].items()):
+            lab = f'{{shard="{sh}"}}'
+            for field in ("n", "f", "threshold"):
+                if sd[field] is not None:
+                    add(f"shard_{field}", "gauge", lab, str(sd[field]))
+            fb = sd["f_budget"]
+            add("f_budget_remaining", "gauge", lab, str(fb["remaining"]))
+            add("members_down", "gauge", lab,
+                str(len(fb["down"]) + len(fb["storage_down"])))
+            for op, s in sd["slo"].items():
+                fam = f"{op}_latency"
+                acc = 0
+                for i, c in enumerate(s["buckets"]):
+                    acc += c
+                    le = BUCKETS[i] if i < len(BUCKETS) else "+Inf"
+                    add(fam, "histogram",
+                        f'_bucket{{shard="{sh}",le="{le}"}}', str(acc))
+                add(fam, "histogram", "_sum" + lab, str(s["sum_s"]))
+                add(fam, "histogram", "_count" + lab, str(s["count"]))
+
+        lines: list[str] = []
+        for base in order:
+            lines.append(f"# TYPE {base} {types[base]}")
+            lines.extend(samples[base])
+        return "\n".join(lines) + "\n"
+
+    # -- background loop ---------------------------------------------------
+
+    def start(self, interval: float | None = None) -> "FleetCollector":
+        if interval is not None:
+            self.interval = interval
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.is_set():
+                try:
+                    self.scrape_once()
+                except Exception:  # scraping must never die
+                    pass
+                self._stop.wait(self.interval)
+
+        self._thread = threading.Thread(
+            target=loop, name="fleet-collector", daemon=True
+        )
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5)
+            self._thread = None

@@ -21,6 +21,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 
 from bftkv_tpu import packet as pkt
 from bftkv_tpu import quorum as qm
@@ -208,14 +209,54 @@ def _batch_cb(tally: _BatchTally, expected: int, per_item_fn):
     return cb
 
 
+class _shard_timer:
+    """Latency timer that observes BOTH the unlabeled series (the
+    historical key bench.py and single-process consumers read) and,
+    when the namespace is sharded, the same series with a ``shard``
+    label — the per-shard SLO histograms the fleet collector merges."""
+
+    __slots__ = ("name", "shard", "_t0")
+
+    def __init__(self, name: str, shard: int | None):
+        self.name = name
+        self.shard = shard
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        dt = time.perf_counter() - self._t0
+        metrics.observe(self.name, dt)
+        if self.shard is not None:
+            metrics.observe(self.name, dt, labels={"shard": self.shard})
+        return False
+
+
 class Client(Protocol):
+    def _shard_label(self, variable: bytes) -> int | None:
+        """The owning shard of ``variable`` for metric labels/span
+        attrs — None when the namespace is unsharded (no label: the
+        unlabeled series IS the whole story there)."""
+        shard_of = getattr(self.qs, "shard_of", None)
+        if shard_of is None:
+            return None
+        try:
+            return shard_of(variable)
+        except Exception:
+            return None
+
     # -- write path (reference: client.go:62-170) -------------------------
 
     def write(self, variable: bytes, value: bytes, proof=None) -> None:
         """Three-phase signed write: collect timestamps from a READ|AUTH
         quorum, then sign + store (reference: client.go:62-92)."""
-        with metrics.timer("client.write.latency"), trace.span(
-            "client.write", attrs={"value_bytes": len(value)}
+        shard = self._shard_label(variable)
+        attrs = {"value_bytes": len(value)}
+        if shard is not None:
+            attrs["shard"] = shard  # slow-trace attribution (trace.py)
+        with _shard_timer("client.write.latency", shard), trace.span(
+            "client.write", attrs=attrs
         ):
             with trace.span("quorum.select"):
                 qr = qm.choose_quorum_for(self.qs, variable, qm.READ | qm.AUTH)
@@ -835,7 +876,13 @@ class Client(Protocol):
         latency but makes the outcome a function of the response SET,
         with the lone signed newest verified cryptographically
         (``_resolve_complete_fanout_many``)."""
-        with metrics.timer("client.read.latency"), trace.span("client.read"):
+        shard = self._shard_label(variable)
+        attrs = {}
+        if shard is not None:
+            attrs["shard"] = shard
+        with _shard_timer("client.read.latency", shard), trace.span(
+            "client.read", attrs=attrs
+        ):
             with trace.span("quorum.select"):
                 q = qm.choose_quorum_for(self.qs, variable, qm.READ)
             req = pkt.serialize(variable, None, 0, None, proof)

@@ -219,7 +219,20 @@ class Server(Protocol):
         quorum's threshold."""
         owns = getattr(self.qs, "owns", None)
         if owns is not None and not owns(variable):
-            metrics.incr("server.wrong_shard")
+            # Labeled by the shard THIS replica serves (a closed enum:
+            # shard indices, bounded by the clique count) — the fleet
+            # collector's anomaly feed attributes misroutes per shard.
+            # Unlabeled when the seat is momentarily unknown (topology
+            # regenerating): a string fallback under the same name
+            # would make Prometheus' sorted() comparison of int and
+            # str label values raise.
+            my_shard = getattr(self.qs, "my_shard", lambda: None)()
+            metrics.incr(
+                "server.wrong_shard",
+                labels=(
+                    {"shard": my_shard} if my_shard is not None else None
+                ),
+            )
             raise ERR_WRONG_SHARD
 
     # -- membership (reference: server.go:64-120) -------------------------
@@ -502,6 +515,7 @@ class Server(Protocol):
                 if self._revoke_signers(
                     sigmod.signers(sig), sigmod.signers(rp.sig)
                 ):
+                    metrics.incr("server.equivocation")
                     raise ERR_EQUIVOCATION
                 raise ERR_INVALID_SIGN_REQUEST  # someone beat me
             if t < rp.t:
@@ -531,12 +545,19 @@ class Server(Protocol):
                 "kind": "collective",
             },
         ):
-            self.crypt.collective.verify(
-                tbss,
-                ss,
-                qm.choose_quorum_for(self.qs, variable, qm.AUTH),
-                self.crypt.keyring,
-            )
+            try:
+                self.crypt.collective.verify(
+                    tbss,
+                    ss,
+                    qm.choose_quorum_for(self.qs, variable, qm.AUTH),
+                    self.crypt.keyring,
+                )
+            except Exception:
+                # A write arriving with a collective signature that does
+                # not verify against the owner quorum is exactly the
+                # Byzantine signal the fleet health plane watches for.
+                metrics.incr("server.verify.collective_fail")
+                raise
 
         out = self._write_storage_checks(variable, val, t, sig, ss, req)
         self._persist(variable, t, out)
@@ -588,6 +609,7 @@ class Server(Protocol):
                     self._revoke_signers(
                         sigmod.signers(ss), sigmod.signers(rp.ss)
                     )
+                metrics.incr("server.equivocation")
                 raise ERR_EQUIVOCATION
 
             # TOFU: the new issuer must match the previous issuer's id

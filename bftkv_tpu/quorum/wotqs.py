@@ -52,6 +52,17 @@ def _howmany(a: int, b: int) -> int:
     return (a + b - 1) // b
 
 
+def bmasking_params(n: int) -> tuple[int, int, int, int]:
+    """``(f, min, threshold, suff)`` for a clique of ``n`` nodes — the
+    b-masking write-path form (wotqs.go:36-70).  THE single source of
+    the formulas: ``_new_qc`` applies its access-type adjustments on
+    top (READ/CERT commit at ``f + 1``; ``suff`` zeroes when the
+    seed's trust weight into the clique is too small), and the fleet
+    health plane (``seat_info``/``/info``) reports these raw values."""
+    f = (n - 1) // 3
+    return f, 3 * f + 1, 2 * f + 1, f + (n - f) // 2 + 1
+
+
 @dataclass
 class QC:
     """One quorum clique with its b-masking parameters (wotqs.go:16-22)."""
@@ -256,12 +267,9 @@ class WotQS:
             return None
         if rw == q.WRITE:
             return QC(nodes, 0, 0, 0, 0)
-        f = (n - 1) // 3
+        f, min_, threshold, suff = bmasking_params(n)
         if f < 1:
             return None
-        min_ = 3 * f + 1
-        threshold = 2 * f + 1
-        suff = f + (n - f) // 2 + 1
         if rw & (q.CERT | q.READ):
             threshold = f + 1
         if weight <= n - suff:
@@ -402,6 +410,54 @@ class WotQS:
         if mine is None:
             return None
         return {b for b in range(ROUTE_BUCKETS) if topo.table[b] == mine}
+
+    def seat_info(self, node_id: int | None = None) -> dict:
+        """One node's shard seat + its clique's b-masking thresholds —
+        the fleet health plane's ``/info`` payload, computed HERE (the
+        only place that owns the quorum math) so HTTP-scraped daemons
+        and in-process chaos fleets can never report different budgets
+        for the same topology.
+
+        ``shard`` is the seat index (0 on unsharded graphs for seated
+        nodes, None for unassigned principals); ``role`` is ``clique``
+        or ``storage``; ``clique`` carries the owner clique's
+        ``n / f / threshold (2f+1) / suff`` and member names — the RAW
+        :func:`bmasking_params` write-path values.  Per-access-type
+        adjustments (READ commits at ``f+1``; ``suff`` zeroed for a
+        low-weight viewer) are viewer/access dependent and belong to
+        ``_new_qc``, not to a fleet-wide health document."""
+        if node_id is None:
+            node_id = self.g.get_self_id()
+        topo = self._topology()
+        nsh = len(topo.shards)
+        mine = topo.shard_index_of(node_id)
+        out: dict = {
+            "shard": (
+                mine if nsh > 1 else (0 if mine is not None else None)
+            ),
+            "shard_count": max(nsh, 1),
+            "role": None,
+            "clique": None,
+            "owned_buckets": ROUTE_BUCKETS,
+        }
+        if mine is None:
+            return out
+        out["role"] = (
+            "clique" if topo.member.get(node_id) == mine else "storage"
+        )
+        if nsh > 1:
+            out["owned_buckets"] = sum(1 for b in topo.table if b == mine)
+        clique = topo.shards[mine]
+        n = len(clique.nodes)
+        f, _min, threshold, suff = bmasking_params(n)
+        out["clique"] = {
+            "n": n,
+            "f": f,
+            "threshold": threshold,
+            "suff": suff,
+            "members": sorted(nd.name for nd in clique.nodes),
+        }
+        return out
 
     def choose_quorum_for(self, x: bytes, rw: int) -> WotQuorum:
         """Keyed quorum selection: hash-route ``x`` to its owner clique.

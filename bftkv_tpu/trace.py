@@ -26,7 +26,13 @@ Deliberately dependency-free, same stance as :mod:`bftkv_tpu.metrics`:
   allocation growth under sustained traffic).  A *root* span (no
   parent) finishing over the slow threshold snapshots its whole trace
   into a separate slow ring and emits one JSON line on the
-  ``bftkv_tpu.trace.slow`` logger — grep-able, machine-parseable;
+  ``bftkv_tpu.trace.slow`` logger — grep-able, machine-parseable, with
+  top-level ``shard``/``peer`` attribution when the trace carries it;
+- every recorded span gets a monotonic **sequence number**, and
+  :meth:`Tracer.export` drains the ring incrementally from a caller
+  cursor — the fleet collector's feed (``/trace?since=N`` on the
+  daemon API): spans stop dying in per-process rings and stitch into
+  cross-process trees in ``bftkv_tpu.obs``;
 - ``/trace`` on the daemon API serves the recent and slow rings.
 
 Span-name taxonomy and label-cardinality rules: docs/DESIGN.md §7.
@@ -88,6 +94,7 @@ class Span:
         "start",
         "duration",
         "attrs",
+        "seq",
         "_t0",
     )
 
@@ -99,6 +106,7 @@ class Span:
         self.start = time.time()
         self.duration = 0.0
         self.attrs = attrs
+        self.seq = 0  # assigned by Tracer.record under its lock
         self._t0 = time.perf_counter()
 
     def context(self) -> SpanContext:
@@ -239,11 +247,17 @@ class Tracer:
         self._lock = threading.Lock()
         self._spans: "deque[Span]" = deque(maxlen=max_spans)
         self._slow: "deque[dict]" = deque(maxlen=max_slow)
+        # Monotonic sequence of recorded spans — the export cursor.
+        # Survives ring wrap-around: a drained reader can tell exactly
+        # how many spans it lost to overwrite (export()'s "dropped").
+        self._seq = 0
 
     # -- recording --------------------------------------------------------
 
     def record(self, sp: Span) -> None:
         with self._lock:
+            self._seq += 1
+            sp.seq = self._seq
             self._spans.append(sp)
         if sp.parent_id is None and sp.duration >= self.slow_threshold:
             self._capture_slow(sp)
@@ -257,6 +271,21 @@ class Tracer:
             "start": root.start,
             "spans": spans,
         }
+        # Attribution without grepping every daemon: the owning shard
+        # (stamped on the root span by the routed client paths) and the
+        # peer behind the slowest rpc.* span — the straggler that most
+        # plausibly burned the budget.
+        shard = root.attrs.get("shard")
+        if shard is not None:
+            entry["shard"] = shard
+        rpcs = [
+            s for s in spans
+            if s["name"].startswith("rpc.") and s.get("attrs", {}).get("peer")
+        ]
+        if rpcs:
+            entry["peer"] = max(rpcs, key=lambda s: s["duration"])[
+                "attrs"
+            ]["peer"]
         with self._lock:
             self._slow.append(entry)
         # One grep-able JSON line per slow request: the root, its
@@ -268,6 +297,10 @@ class Tracer:
                 "root": root.name,
                 "duration_s": round(root.duration, 6),
                 "threshold_s": self.slow_threshold,
+                **({"shard": shard} if shard is not None else {}),
+                **(
+                    {"peer": entry["peer"]} if "peer" in entry else {}
+                ),
                 "spans": [
                     {
                         "name": s["name"],
@@ -279,6 +312,38 @@ class Tracer:
             }, default=str))
         except Exception:  # a weird attr value must never kill a request
             pass
+
+    # -- export (the fleet collector's feed) ------------------------------
+
+    def export(self, since: int = 0) -> dict:
+        """Incremental drain: every retained span recorded after cursor
+        ``since`` (0 = from the beginning), oldest first.
+
+        Returns ``{"cursor", "dropped", "spans"}`` — pass ``cursor``
+        back as the next ``since``.  ``dropped`` counts spans that were
+        recorded after ``since`` but already overwritten by the bounded
+        ring before this drain (a slow scraper loses the oldest spans,
+        never blocks the hot path).  A ``since`` ahead of the current
+        sequence means the process (or the ring) restarted: the drain
+        resyncs from the beginning rather than returning nothing
+        forever.  Read-only — concurrent exports with different cursors
+        (several collectors) do not disturb each other."""
+        with self._lock:
+            seq = self._seq
+            if since > seq:
+                since = 0
+            fresh = [s for s in self._spans if s.seq > since]
+        # Serialize OUTSIDE the lock (same discipline as percentile/
+        # snapshot in metrics.py): a near-full-ring drain would
+        # otherwise stall every concurrent record() — a span is
+        # immutable once recorded, so the reference snapshot suffices.
+        out = [s.to_dict() for s in fresh]
+        oldest = fresh[0].seq if fresh else seq + 1
+        return {
+            "cursor": seq,
+            "dropped": max(0, oldest - since - 1),
+            "spans": out,
+        }
 
     # -- querying ---------------------------------------------------------
 
@@ -327,6 +392,7 @@ class Tracer:
         with self._lock:
             self._spans.clear()
             self._slow.clear()
+            self._seq = 0  # export() resyncs stale cursors from zero
 
 
 tracer = Tracer()

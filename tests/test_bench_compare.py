@@ -1,0 +1,104 @@
+"""tools/bench_compare.py: the perf-regression gate over bench records."""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(__file__)), "tools")
+)
+from bench_compare import compare, extract_sections, main  # noqa: E402
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def driver_record(sections):
+    return {"n": 5, "cmd": "python bench.py", "rc": 0,
+            "parsed": {"metric": "x", "value": 1, "unit": "u",
+                       "extra": {"sections": sections}}}
+
+
+def detail_record(sections):
+    return {"metric": "x", "value": 1, "unit": "u",
+            "extra": {"sections": sections}}
+
+
+def test_extracts_both_formats():
+    d = extract_sections(driver_record({"cluster_4": ["cpu", 7.5],
+                                        "rns_kernel": "skip"}))
+    assert d["cluster_4"] == ("cpu", 7.5)
+    assert d["rns_kernel"] == ("skip", None)
+    d = extract_sections(detail_record({
+        "cluster_4": {"backend": "cpu", "writes_per_sec": 18.6},
+        "cluster_shards": {"backend": "cpu", "writes_per_sec": 55.0},
+        "kernel": {"backend": "tpu", "rsa2048_verifies_per_sec": 5e5},
+        "bad": {"error": "boom"},
+    }))
+    assert d["cluster_4"] == ("cpu", 18.6)
+    assert d["kernel"][1] == 5e5
+    assert d["bad"] == ("err", None)
+
+
+def test_improvement_and_within_threshold_pass():
+    old = driver_record({"cluster_4": ["cpu", 10.0],
+                         "cluster_16": ["cpu", 10.0]})
+    new = driver_record({"cluster_4": ["cpu", 20.0],
+                         "cluster_16": ["cpu", 7.5]})  # -25% < 30%
+    lines, regressions, compared = compare(old, new)
+    assert regressions == []
+    assert compared == 2
+
+
+def test_regression_detected_and_gated():
+    old = driver_record({"cluster_4": ["cpu", 10.0]})
+    new = driver_record({"cluster_4": ["cpu", 6.0]})  # -40%
+    _lines, regressions, _compared = compare(old, new)
+    assert regressions == ["cluster_4"]
+
+
+def test_backend_change_not_compared():
+    old = driver_record({"cluster_4": ["tpu", 100.0]})
+    new = driver_record({"cluster_4": ["cpu-fallback", 6.0]})
+    lines, regressions, compared = compare(old, new)
+    assert regressions == []
+    assert compared == 1  # engaged (visible), just not numeric
+    assert any("backend changed" in ln for ln in lines)
+
+
+def test_non_cluster_sections_ignored_by_default():
+    old = driver_record({"rns_kernel": ["tpu", 100.0]})
+    new = driver_record({"rns_kernel": ["tpu", 1.0]})
+    _lines, regressions, compared = compare(old, new)
+    assert regressions == []
+    assert compared == 0
+
+
+def test_cli_on_committed_trajectory(tmp_path):
+    """The CI invocation: the previous committed round vs the current
+    one must load, compare, and pass."""
+    old = os.path.join(REPO, "BENCH_r05.json")
+    new = os.path.join(REPO, "BENCH_r06.json")
+    assert os.path.exists(old)
+    assert os.path.exists(new), "BENCH_r06.json must be committed"
+    assert main([old, new]) == 0
+
+
+def test_cli_exit_codes(tmp_path):
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(driver_record({"cluster_4": ["cpu", 10.0]})))
+    b.write_text(json.dumps(driver_record({"cluster_4": ["cpu", 5.0]})))
+    assert main([str(a), str(b)]) == 1
+    assert main([str(a), str(a)]) == 0
+
+
+def test_cli_fails_loudly_when_gate_gated_nothing(tmp_path):
+    """Format drift / section renames must not silently disable the
+    gate: zero engaged sections is its own failure (exit 2)."""
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    a.write_text(json.dumps(driver_record({"cluster_4": ["cpu", 10.0]})))
+    b.write_text(json.dumps(driver_record({"cluster_four": ["cpu", 10.0]})))
+    assert main([str(a), str(b)]) == 2

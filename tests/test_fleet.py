@@ -1,0 +1,466 @@
+"""Fleet health plane (bftkv_tpu/obs): trace export/drain semantics,
+cross-process stitching, f-budget aggregation, the anomaly feed, and
+the /fleet HTTP surface — all against fake or in-process sources (the
+live-cluster path is tests/test_fleet_cluster.py)."""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+
+from bftkv_tpu import trace
+from bftkv_tpu.metrics import BUCKETS, Metrics
+from bftkv_tpu.obs import FleetCollector, Stitcher
+from bftkv_tpu.obs.collector import parse_flat_key
+
+
+# -- trace export / drain ---------------------------------------------------
+
+
+def test_export_cursor_drains_incrementally():
+    t = trace.Tracer(max_spans=64)
+    old, trace.tracer = trace.tracer, t
+    try:
+        with trace.span("a"):
+            pass
+        out = t.export(0)
+        assert [s["name"] for s in out["spans"]] == ["a"]
+        assert out["dropped"] == 0
+        cur = out["cursor"]
+        with trace.span("b"):
+            pass
+        out2 = t.export(cur)
+        assert [s["name"] for s in out2["spans"]] == ["b"]
+        # nothing new: empty drain, cursor stable
+        out3 = t.export(out2["cursor"])
+        assert out3["spans"] == [] and out3["dropped"] == 0
+    finally:
+        trace.tracer = old
+
+
+def test_export_reports_ring_overflow_as_dropped():
+    t = trace.Tracer(max_spans=4)
+    old, trace.tracer = trace.tracer, t
+    try:
+        cur = t.export(0)["cursor"]
+        for i in range(10):
+            with trace.span(f"s{i}"):
+                pass
+        out = t.export(cur)
+        # ring holds the newest 4; the 6 older ones are honestly lost
+        assert len(out["spans"]) == 4
+        assert out["dropped"] == 6
+        # a cursor AHEAD of the sequence (process restarted) resyncs
+        t.reset()
+        with trace.span("fresh"):
+            pass
+        out2 = t.export(cur + 1000)
+        assert [s["name"] for s in out2["spans"]] == ["fresh"]
+    finally:
+        trace.tracer = old
+
+
+def test_export_vs_record_race_loses_nothing():
+    """Concurrent drain-vs-record: every recorded span shows up in
+    exactly one drain (no loss, no duplication) as long as the ring
+    does not overflow."""
+    t = trace.Tracer(max_spans=65536)
+    old, trace.tracer = trace.tracer, t
+    try:
+        n_threads, per_thread = 4, 500
+        seen: list = []
+        stop = threading.Event()
+
+        def drain():
+            cur = 0
+            while True:
+                out = t.export(cur)
+                assert out["dropped"] == 0
+                cur = out["cursor"]
+                seen.extend(s["name"] for s in out["spans"])
+                if stop.is_set() and not out["spans"]:
+                    return
+
+        def record(k: int):
+            for i in range(per_thread):
+                with trace.span(f"w{k}.{i}"):
+                    pass
+
+        drainer = threading.Thread(target=drain)
+        writers = [
+            threading.Thread(target=record, args=(k,))
+            for k in range(n_threads)
+        ]
+        drainer.start()
+        for w in writers:
+            w.start()
+        for w in writers:
+            w.join()
+        stop.set()
+        drainer.join()
+        assert len(seen) == n_threads * per_thread
+        assert len(set(seen)) == len(seen)
+    finally:
+        trace.tracer = old
+
+
+def test_slow_trace_carries_shard_and_peer():
+    t = trace.Tracer(slow_threshold=0.0)
+    old, trace.tracer = trace.tracer, t
+    try:
+        with trace.span("client.write", attrs={"shard": 1}):
+            with trace.span("rpc.write", attrs={"peer": "b02"}):
+                pass
+        entry = t.slow()[0]
+        assert entry["shard"] == 1
+        assert entry["peer"] == "b02"
+    finally:
+        trace.tracer = old
+
+
+# -- stitching --------------------------------------------------------------
+
+
+def _span(tid, sid, name, parent=None, duration=1.0, attrs=None):
+    d = {
+        "trace": tid,
+        "span": sid,
+        "name": name,
+        "start": 0.0,
+        "duration": duration,
+    }
+    if parent:
+        d["parent"] = parent
+    if attrs:
+        d["attrs"] = attrs
+    return d
+
+
+def test_stitcher_joins_sources_and_dedups():
+    st = Stitcher()
+    assert st.add("a01", [_span("t1", "s1", "client.write", duration=2.0)]) == 1
+    # re-scrape overlap: same span again is not double counted
+    assert st.add("a01", [_span("t1", "s1", "client.write")]) == 0
+    st.add("rw01", [_span("t1", "s2", "server.write", parent="s1")])
+    assert st.summary() == {"traces": 1, "stitched": 1}
+    [tr] = st.traces()
+    assert tr["root"] == "client.write" and tr["stitched"]
+    assert tr["sources"] == ["a01", "rw01"]
+    tree = st.tree("t1")
+    assert tree["children"][0]["name"] == "client.write"
+    assert tree["children"][0]["children"][0]["src"] == "rw01"
+    assert st.tree("nope") is None
+
+
+def test_stitcher_bounded():
+    st = Stitcher(max_traces=4)
+    for i in range(10):
+        st.add("x", [_span(f"t{i}", f"s{i}", "root")])
+    assert st.summary()["traces"] == 4
+
+
+# -- flat-key parsing -------------------------------------------------------
+
+
+def test_parse_flat_key():
+    assert parse_flat_key("plain") == ("plain", {})
+    assert parse_flat_key("a.b{shard=1,le=0.5}") == (
+        "a.b", {"shard": "1", "le": "0.5"}
+    )
+
+
+# -- collector over fake sources --------------------------------------------
+
+
+class FakeSource:
+    """A scriptable fleet member."""
+
+    def __init__(self, name, shard, clique, up=True):
+        self.name = name
+        self.up = up
+        self._info = {
+            "name": name,
+            "shard": shard,
+            "shard_count": 2,
+            "role": "clique" if name in clique["members"] else "storage",
+            "clique": clique,
+            "owned_buckets": 128,
+        }
+        self.snap: dict = {}
+        self.spans: list = []
+        self.slow: list = []
+
+    def info(self):
+        return self._info
+
+    def metrics(self):
+        if not self.up:
+            raise OSError("down")
+        return self.snap
+
+    def trace_export(self, cursor):
+        return {
+            "cursor": cursor + len(self.spans),
+            "dropped": 0,
+            "spans": self.spans,
+            "slow": self.slow,
+        }
+
+    def probe(self):
+        return self.up
+
+
+def _clique(names):
+    n = len(names)
+    f = (n - 1) // 3
+    return {
+        "n": n,
+        "f": f,
+        "threshold": 2 * f + 1,
+        "suff": f + (n - f) // 2 + 1,
+        "members": sorted(names),
+    }
+
+
+def _two_shard_fleet():
+    ca = _clique(["a01", "a02", "a03", "a04"])
+    cb = _clique(["b01", "b02", "b03", "b04"])
+    srcs = [FakeSource(n, 0, ca) for n in ca["members"]]
+    srcs += [FakeSource(n, 1, cb) for n in cb["members"]]
+    srcs.append(FakeSource("rw01", 0, ca))  # storage member of shard 0
+    return srcs
+
+
+def test_f_budget_decrements_only_the_dark_members_shard():
+    srcs = _two_shard_fleet()
+    coll = FleetCollector(srcs)
+    doc = coll.scrape_once()
+    assert set(doc["shards"]) == {"0", "1"}
+    for sd in doc["shards"].values():
+        assert sd["f_budget"] == {
+            "f": 1, "used": 0, "remaining": 1, "down": [],
+            "storage_down": [],
+        }
+    next(s for s in srcs if s.name == "b02").up = False
+    doc = coll.scrape_once()
+    assert doc["shards"]["1"]["f_budget"]["remaining"] == 0
+    assert doc["shards"]["1"]["f_budget"]["down"] == ["b02"]
+    assert doc["shards"]["0"]["f_budget"]["remaining"] == 1
+    kinds = [(a["kind"], a["source"], a["shard"]) for a in doc["anomalies"]]
+    assert ("member_down", "b02", 1) in kinds
+    # a dark STORAGE node alarms but does not consume the clique budget
+    next(s for s in srcs if s.name == "rw01").up = False
+    doc = coll.scrape_once()
+    assert doc["shards"]["0"]["f_budget"]["remaining"] == 1
+    assert doc["shards"]["0"]["f_budget"]["storage_down"] == ["rw01"]
+    # recovery emits member_up and restores the budget
+    next(s for s in srcs if s.name == "b02").up = True
+    doc = coll.scrape_once()
+    assert doc["shards"]["1"]["f_budget"]["remaining"] == 1
+    assert any(a["kind"] == "member_up" for a in doc["anomalies"])
+
+
+def test_counter_deltas_become_anomalies_once():
+    srcs = _two_shard_fleet()
+    coll = FleetCollector(srcs)
+    coll.scrape_once()
+    a01 = srcs[0]
+    a01.snap = {"server.wrong_shard{shard=0}": 3, "server.equivocation": 1}
+    doc = coll.scrape_once()
+    got = {
+        (a["kind"], a["source"], a["shard"], a["count"])
+        for a in doc["anomalies"]
+    }
+    assert ("wrong_shard", "a01", 0, 3) in got
+    assert ("equivocation", "a01", 0, 1) in got
+    # unchanged counters do not re-fire
+    n = len(coll.anomalies())
+    coll.scrape_once()
+    assert len(coll.anomalies()) == n
+
+
+def test_slo_histograms_merge_across_members_per_shard():
+    srcs = _two_shard_fleet()
+    bucket_of = lambda le: (
+        f"client.write.latency.bucket{{shard=1,le={le}}}"
+    )
+    # two daemons each observed one write into the 0.25 bucket
+    for s in srcs[4:6]:
+        s.snap = {bucket_of(0.25): 1}
+    coll = FleetCollector(srcs)
+    doc = coll.scrape_once()
+    slo = doc["shards"]["1"]["slo"]["write"]
+    assert slo["count"] == 2
+    assert slo["p50_le_s"] == 0.25
+    assert slo["buckets"][BUCKETS.index(0.25)] == 2
+    assert "write" not in doc["shards"]["0"]["slo"]
+
+
+def test_slow_entries_become_shard_exemplars():
+    srcs = _two_shard_fleet()
+    srcs[0].slow = [
+        {"trace_id": "abc", "root": "client.write", "duration": 2.0,
+         "shard": 0, "peer": "a03"}
+    ]
+    coll = FleetCollector(srcs)
+    doc = coll.scrape_once()
+    [ex] = doc["shards"]["0"]["exemplars"]
+    assert ex["trace_id"] == "abc" and ex["peer"] == "a03"
+    assert doc["shards"]["1"]["exemplars"] == []
+
+
+def test_fleet_http_endpoint_json_and_prometheus():
+    from bftkv_tpu.obs.http import serve_fleet
+
+    coll = FleetCollector(_two_shard_fleet())
+    coll.scrape_once()
+    httpd = serve_fleet(coll, "127.0.0.1:0")
+    port = httpd.server_address[1]
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/fleet", timeout=10
+        ) as r:
+            assert r.headers["content-type"].startswith("application/json")
+            doc = json.loads(r.read())
+        assert doc["fleet"]["daemons"] == 9
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/fleet",
+            headers={"accept": "text/plain"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            text = r.read().decode()
+        assert 'bftkv_fleet_f_budget_remaining{shard="0"} 1' in text
+        assert 'bftkv_fleet_f_budget_remaining{shard="1"} 1' in text
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/healthz", timeout=10
+        ) as r:
+            assert r.read() == b"ok\n"
+    finally:
+        httpd.shutdown()
+
+
+def test_local_metrics_feed_and_render():
+    """The in-process feed path (nemesis mode): a process-wide registry
+    backs counter-delta anomalies, and the CLI renderer accepts the
+    document."""
+    from bftkv_tpu.cmd.fleet import render
+
+    reg = Metrics()
+    coll = FleetCollector(_two_shard_fleet(), local_metrics=reg)
+    coll.scrape_once()
+    reg.incr("transport.peer.opens", 2)
+    doc = coll.scrape_once()
+    assert any(
+        a["kind"] == "peer_circuit_open" and a["count"] == 2
+        for a in doc["anomalies"]
+    )
+    text = render(doc)
+    assert "shard 0" in text and "budget 1/1" in text
+
+
+def test_fleet_prometheus_one_type_line_per_family():
+    """A second '# TYPE' line for one metric name is a parse error in
+    a real Prometheus server — multi-shard fleets must group samples
+    per family (and histograms need a _sum for rate(sum)/rate(count))."""
+    srcs = _two_shard_fleet()
+    for s in srcs[:2]:
+        s.snap = {
+            "client.write.latency.bucket{shard=0,le=0.25}": 1,
+            "client.write.latency.sum{shard=0}": 0.2,
+        }
+    for s in srcs[4:6]:
+        s.snap = {
+            "client.write.latency.bucket{shard=1,le=0.5}": 1,
+            "client.write.latency.sum{shard=1}": 0.4,
+        }
+    coll = FleetCollector(srcs)
+    coll.scrape_once()
+    text = coll.prometheus()
+    seen = set()
+    for line in text.splitlines():
+        if line.startswith("# TYPE"):
+            _, _, name, _typ = line.split()
+            assert name not in seen, f"duplicate TYPE for {name}"
+            seen.add(name)
+    assert 'bftkv_fleet_shard_n{shard="0"} 4' in text
+    assert 'bftkv_fleet_shard_n{shard="1"} 4' in text
+    assert 'bftkv_fleet_write_latency_sum{shard="0"} 0.4' in text
+    assert 'bftkv_fleet_write_latency_sum{shard="1"} 0.8' in text
+    assert 'bftkv_fleet_write_latency_count{shard="0"} 2' in text
+    doc = coll.health()
+    assert doc["shards"]["0"]["slo"]["write"]["sum_s"] == 0.4
+
+
+def test_info_refreshes_on_cadence_and_recovery():
+    """Topology is not static: the collector re-fetches /info on a
+    scrape cadence (and after a down→up transition) so membership
+    churn reseats the health document instead of going stale."""
+    srcs = _two_shard_fleet()
+    coll = FleetCollector(srcs)
+    coll.INFO_REFRESH_SCRAPES = 10**9  # cadence off for this test
+    coll.scrape_once()
+    mover = next(s for s in srcs if s.name == "a04")
+    mover._info = dict(mover._info, shard=1)
+    coll.scrape_once()
+    # no refresh yet: still seated in shard 0
+    assert any(
+        m["name"] == "a04"
+        for m in coll.health()["shards"]["0"]["members"]
+    )
+    coll.INFO_REFRESH_SCRAPES = 1  # every scrape is a refresh tick
+    coll.scrape_once()
+    doc = coll.health()
+    assert any(
+        m["name"] == "a04" for m in doc["shards"]["1"]["members"]
+    )
+    assert not any(
+        m["name"] == "a04" for m in doc["shards"]["0"]["members"]
+    )
+    # recovery refresh: a member that went down and came back re-reads
+    # its seat even with the cadence off
+    coll.INFO_REFRESH_SCRAPES = 10**9
+    mover.up = False
+    coll.scrape_once()
+    mover._info = dict(mover._info, shard=0)
+    mover.up = True
+    coll.scrape_once()  # up-transition marks stale...
+    coll.scrape_once()  # ...next scrape re-fetches
+    assert any(
+        m["name"] == "a04"
+        for m in coll.health()["shards"]["0"]["members"]
+    )
+
+
+def test_down_from_boot_member_is_unseated_not_misbinned():
+    """A member that never answered /info has an UNKNOWN seat: binning
+    it into shard 0 would let its real shard report a full f-budget
+    with a clique member dark.  It must surface as fleet.unseated (and
+    the CLI must refuse to call the fleet healthy)."""
+    from bftkv_tpu.cmd.fleet import _exit_code
+
+    class DeadSource:
+        name = "127.0.0.1:9"
+
+        def info(self):
+            raise OSError("connection refused")
+
+        def metrics(self):
+            raise OSError("connection refused")
+
+        def trace_export(self, cursor):
+            raise OSError("connection refused")
+
+        def probe(self):
+            return False
+
+    srcs = _two_shard_fleet() + [DeadSource()]
+    coll = FleetCollector(srcs)
+    doc = coll.scrape_once()
+    assert doc["fleet"]["unseated"] == ["127.0.0.1:9"]
+    assert "127.0.0.1:9" in doc["fleet"]["down"]
+    # no shard claims it, and no budget silently absorbs it
+    for sd in doc["shards"].values():
+        assert all(m["name"] != "127.0.0.1:9" for m in sd["members"])
+        assert sd["f_budget"]["remaining"] == sd["f_budget"]["f"]
+    assert _exit_code(doc) == 1

@@ -1,0 +1,119 @@
+"""Acceptance path for the fleet health plane: a live 2-shard loopback
+cluster, scraped → aggregated → f-budget.  Kill one clique replica and
+exactly that shard's budget decrements while the other stays full
+(ISSUE 7 acceptance criterion), with the outage in the anomaly feed.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from bftkv_tpu import trace
+from bftkv_tpu.metrics import registry
+from bftkv_tpu.obs import FleetCollector, LocalSource
+from tests.cluster_utils import start_cluster
+
+
+@pytest.fixture(scope="module")
+def fleet():
+    cl = start_cluster(4, 1, 4, bits=1024, n_shards=2)
+    idents = cl.universe.servers + cl.universe.storage_nodes
+    sources = [
+        LocalSource(ident.name, (lambda s=srv: s))
+        for ident, srv in zip(idents, cl.all_servers)
+    ]
+    coll = FleetCollector(
+        sources, local_metrics=registry, local_tracer=trace.tracer
+    )
+    yield cl, coll
+    cl.stop()
+
+
+def shard_key(client, shard, tag=b"fleet"):
+    i = 0
+    while i < 4096:
+        k = b"%s/%d" % (tag, i)
+        if client.qs.shard_of(k) == shard:
+            return k
+        i += 1
+    raise AssertionError("no key for shard")
+
+
+def test_scrape_aggregate_f_budget(fleet):
+    cl, coll = fleet
+    c = cl.clients[0]
+    for sh in (0, 1):
+        c.write(shard_key(c, sh), b"v")
+    doc = coll.scrape_once()
+    assert set(doc["shards"]) == {"0", "1"}
+    for sh, sd in doc["shards"].items():
+        # thresholds straight from the wotqs b-masking math for n=4
+        assert (sd["n"], sd["f"], sd["threshold"]) == (4, 1, 3)
+        assert sd["f_budget"] == {
+            "f": 1, "used": 0, "remaining": 1, "down": [],
+            "storage_down": [],
+        }
+        # the routed writes produced a per-shard merged write SLO
+        assert sd["slo"]["write"]["count"] >= 1
+    assert doc["traces"]["traces"] >= 2
+    assert doc["fleet"]["up"] == 16
+
+
+def test_kill_one_replica_decrements_exactly_that_shard(fleet):
+    cl, coll = fleet
+    # a clique member of shard 1 (not shard 0, to prove attribution)
+    victim_name = None
+    for srv in cl.servers:
+        if srv.qs.my_shard() == 1:
+            victim_name = srv.self_node.name
+            srv.tr.stop()
+            break
+    assert victim_name
+    doc = coll.scrape_once()
+    assert doc["shards"]["1"]["f_budget"]["used"] == 1
+    assert doc["shards"]["1"]["f_budget"]["remaining"] == 0
+    assert doc["shards"]["1"]["f_budget"]["down"] == [victim_name]
+    assert doc["shards"]["0"]["f_budget"] == {
+        "f": 1, "used": 0, "remaining": 1, "down": [], "storage_down": [],
+    }
+    assert any(
+        a["kind"] == "member_down"
+        and a["source"] == victim_name
+        and a["shard"] == 1
+        for a in doc["anomalies"]
+    )
+    # the shard is AT its fault bound but still live: a routed write to
+    # the degraded shard must still commit (2f+1 of the remaining 3)
+    c = cl.clients[0]
+    k = shard_key(c, 1, tag=b"fleet/degraded")
+    c.write(k, b"still-live")
+    assert c.read(k) == b"still-live"
+
+
+def test_fleet_endpoint_serves_the_same_budget(fleet):
+    """The /fleet HTTP surface over the live collector reports the
+    degraded shard exactly as the in-process document does."""
+    import json
+    import urllib.request
+
+    from bftkv_tpu.obs.http import serve_fleet
+
+    _cl, coll = fleet
+    httpd = serve_fleet(coll, "127.0.0.1:0")
+    try:
+        port = httpd.server_address[1]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/fleet", timeout=10
+        ) as r:
+            doc = json.loads(r.read())
+        assert doc["shards"]["1"]["f_budget"]["remaining"] == 0
+        assert doc["shards"]["0"]["f_budget"]["remaining"] == 1
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/fleet",
+            headers={"accept": "text/plain"},
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            text = r.read().decode()
+        assert 'bftkv_fleet_f_budget_remaining{shard="1"} 0' in text
+    finally:
+        httpd.shutdown()

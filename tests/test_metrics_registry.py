@@ -67,7 +67,7 @@ def test_prometheus_exposition_is_scrapable():
             continue
         if line.startswith("#"):
             assert re.match(r"^# TYPE [a-zA-Z_:][a-zA-Z0-9_:]* "
-                            r"(counter|gauge|summary)$", line), line
+                            r"(counter|gauge|histogram)$", line), line
             continue
         assert _PROM_LINE.match(line), f"unscrapable line: {line!r}"
         sample_lines.append(line)
@@ -84,8 +84,11 @@ def test_prometheus_exposition_is_scrapable():
         mobj = re.match(r"^# TYPE (\S+) counter$", line)
         if mobj:
             assert mobj.group(1).endswith("_total"), line
-    # summaries expose quantiles + _sum/_count
-    assert 'bftkv_client_write_latency{quantile="0.5"}' in text
+    # observe() series expose fixed-bucket histograms + _sum/_count
+    assert "# TYPE bftkv_client_write_latency histogram" in text
+    assert 'bftkv_client_write_latency_bucket{le="0.01"} 1' in text
+    assert 'bftkv_client_write_latency_bucket{le="0.025"} 2' in text
+    assert 'bftkv_client_write_latency_bucket{le="+Inf"} 2' in text
     assert "bftkv_client_write_latency_sum" in text
     assert "bftkv_client_write_latency_count 2" in text
     # gauges typed as gauge
@@ -145,3 +148,31 @@ def test_reset_clears_everything():
     m.reset()
     assert m.snapshot() == {}
     assert m.prometheus() == "\n"
+
+
+def test_histograms_merge_across_instances():
+    """The fixed-ladder contract the fleet collector leans on: two
+    registries' bucket vectors sum element-wise and the merged quantile
+    estimate is computable from the sum alone (per-daemon summary
+    quantiles can't do this — DESIGN.md §11)."""
+    from bftkv_tpu.metrics import BUCKETS, histogram_quantile
+
+    a, b = Metrics(), Metrics()
+    for v in (0.002, 0.002, 0.02):
+        a.observe("lat", v, labels={"shard": 0})
+    for v in (0.2, 0.2, 0.2, 7.0):
+        b.observe("lat", v, labels={"shard": 0})
+    ha = a.histograms()["lat{shard=0}"]
+    hb = b.histograms()["lat{shard=0}"]
+    assert len(ha["buckets"]) == len(BUCKETS) + 1
+    merged = [x + y for x, y in zip(ha["buckets"], hb["buckets"])]
+    assert sum(merged) == 7
+    assert ha["count"] + hb["count"] == 7
+    # 4 of 7 samples are <= 0.25 -> the p50 bucket is le=0.25
+    assert histogram_quantile(0.5, merged) == 0.25
+    assert histogram_quantile(0.99, merged) == 10.0
+    assert histogram_quantile(0.5, [0] * (len(BUCKETS) + 1)) is None
+    # snapshot carries the same counts as flat .bucket{le=} keys
+    snap = a.snapshot()
+    assert snap["lat.bucket{shard=0,le=0.0025}"] == 2
+    assert snap["lat.bucket{shard=0,le=0.025}"] == 1

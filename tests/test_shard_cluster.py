@@ -177,3 +177,46 @@ def test_sync_plane_is_shard_aware(cluster):
     # 3. a full round against live same-shard peers converges clean.
     got = daemon.run_round()
     assert got["rejected"] == 0
+
+
+def test_shard_labels_are_a_closed_enum(cluster):
+    """Label hygiene for the routing plane (PR 2's cardinality rule
+    applied to the new ``shard`` labels): after routed traffic plus a
+    wrong-shard rejection, every ``shard=`` label value across the
+    whole registry is a shard index — an integer below the shard
+    count — so the label space is bounded by topology, never by keys,
+    peers, or request volume."""
+    from bftkv_tpu.metrics import registry
+    from bftkv_tpu.obs.collector import parse_flat_key
+
+    c = cluster.clients[0]
+    nsh = c.qs.shard_count()
+    # the registry is process-global: flush residue from earlier tests
+    # (a wider topology would leave higher shard indices behind)
+    registry.reset()
+    ks = keys_per_shard(c, tag=b"labels")
+    for idx, keys in ks.items():
+        c.write(keys[0], b"labeled")
+        c.read(keys[0])
+    # drive the wrong-shard gate so server.wrong_shard{shard=} exists
+    k0 = ks[0][0]
+    srv = shard_servers(cluster, 1)[0]
+    with pytest.raises(ERR_WRONG_SHARD):
+        srv._time(k0, None, None)
+
+    snap = registry.snapshot()
+    shard_series = {}
+    for key in snap:
+        name, labels = parse_flat_key(key)
+        if "shard" in labels:
+            shard_series.setdefault(name, set()).add(labels["shard"])
+    # the three routed hot-path families all carry the label...
+    assert any(n.startswith("quorum.route.shard") for n in shard_series)
+    assert any(n.startswith("server.wrong_shard") for n in shard_series)
+    assert any(
+        n.startswith("client.write.latency") for n in shard_series
+    )
+    # ...and every value anywhere is a bounded shard index
+    for name, values in shard_series.items():
+        for v in values:
+            assert v.isdigit() and int(v) < nsh, (name, v)

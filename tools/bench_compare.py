@@ -1,0 +1,170 @@
+#!/usr/bin/env python3
+"""Diff two bench records; fail on cluster-section regressions.
+
+The bench trajectory (BENCH_r01.json, BENCH_r02.json, ...) only means
+something if someone reads it — this is the reader.  It compares the
+*cluster* sections (the end-to-end numbers a protocol/transport/storage
+regression actually moves; kernel sections swing with the accelerator
+tunnel and are excluded by default) of two bench records and exits
+non-zero when any shared section regressed more than ``--threshold``
+(default 30%).
+
+Accepted inputs, auto-detected per file:
+
+- a driver round record (``BENCH_rNN.json``): sections under
+  ``parsed.extra.sections``, each a compact ``[status, number]`` pair;
+- a full bench record (``BENCH_detail.json`` / bench.py stderr line):
+  sections under ``extra.sections`` as dicts;
+- a bare ``{"sections": {...}}`` dict.
+
+Sections measured on different backend classes (tpu vs cpu) are
+reported but never compared — a tunnel flap is not a regression.  Use
+from CI::
+
+    python tools/bench_compare.py BENCH_r05.json BENCH_r06.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+__all__ = ["compare", "extract_sections", "main"]
+
+
+def _backend_class(status: str) -> str:
+    s = (status or "").lower()
+    return "cpu" if s.startswith("cpu") else "tpu"
+
+
+def extract_sections(doc: dict) -> dict:
+    """``{section name: (status, headline number | None)}``."""
+    sections = None
+    for path in (("parsed", "extra", "sections"), ("extra", "sections"),
+                 ("sections",)):
+        node = doc
+        for k in path:
+            node = node.get(k) if isinstance(node, dict) else None
+            if node is None:
+                break
+        if isinstance(node, dict):
+            sections = node
+            break
+    out: dict = {}
+    if sections is None:
+        return out
+    for name, sec in sections.items():
+        if isinstance(sec, (list, tuple)) and len(sec) == 2:
+            status, num = sec
+            out[name] = (str(status), num if isinstance(
+                num, (int, float)
+            ) else None)
+        elif isinstance(sec, dict):
+            if "skipped" in sec:
+                out[name] = ("skip", None)
+                continue
+            if "error" in sec:
+                out[name] = ("err", None)
+                continue
+            num = sec.get("writes_per_sec")
+            if not isinstance(num, (int, float)):
+                num = next(
+                    (
+                        v
+                        for k, v in sec.items()
+                        if k.endswith("_per_sec")
+                        and isinstance(v, (int, float))
+                    ),
+                    None,
+                )
+            out[name] = (str(sec.get("backend", "?")), num)
+        elif isinstance(sec, str):
+            out[name] = (sec, None)
+    return out
+
+
+def compare(
+    old: dict, new: dict, threshold: float = 0.30, prefix: str = "cluster"
+) -> tuple[list[str], list[str], int]:
+    """Returns ``(report lines, regression lines, sections engaged)``.
+    Engaged counts sections the gate actually looked at — numerically
+    compared, or explicitly reported as backend-incomparable.  Zero
+    means the gate gated NOTHING (format drift, section renames);
+    callers must treat that as its own failure, or the regression gate
+    silently stops gating."""
+    a = extract_sections(old)
+    b = extract_sections(new)
+    lines: list[str] = []
+    regressions: list[str] = []
+    compared = 0
+    shared = sorted(set(a) & set(b))
+    for name in shared:
+        if prefix and not name.startswith(prefix):
+            continue
+        (sa, va), (sb, vb) = a[name], b[name]
+        if va is None or vb is None:
+            lines.append(f"  {name}: no shared number "
+                         f"({sa}:{va} -> {sb}:{vb}), skipped")
+            continue
+        if _backend_class(sa) != _backend_class(sb):
+            lines.append(
+                f"  {name}: backend changed ({sa} -> {sb}), not compared"
+            )
+            compared += 1  # the gate engaged; incomparability is visible
+            continue
+        ratio = vb / va if va else float("inf")
+        verdict = "ok"
+        if ratio < 1.0 - threshold:
+            verdict = f"REGRESSION (>{threshold:.0%} drop)"
+            regressions.append(name)
+        compared += 1
+        lines.append(
+            f"  {name}: {va:g} -> {vb:g}  ({ratio:.2f}x)  {verdict}"
+        )
+    if not any(name.startswith(prefix) for name in shared):
+        lines.append(f"  (no shared '{prefix}*' sections)")
+    return lines, regressions, compared
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="compare two bench JSON records; non-zero exit on "
+                    "cluster-section regression"
+    )
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--threshold", type=float, default=0.30,
+                    help="maximum tolerated fractional drop (default 0.30)")
+    ap.add_argument("--prefix", default="cluster",
+                    help="only compare sections with this name prefix "
+                         "(default: cluster; '' = all)")
+    args = ap.parse_args(argv)
+
+    with open(args.old) as f:
+        old = json.load(f)
+    with open(args.new) as f:
+        new = json.load(f)
+    lines, regressions, compared = compare(
+        old, new, threshold=args.threshold, prefix=args.prefix
+    )
+    print(f"bench_compare: {args.old} -> {args.new} "
+          f"(threshold {args.threshold:.0%})")
+    for ln in lines:
+        print(ln)
+    if regressions:
+        print(f"bench_compare: {len(regressions)} regression(s): "
+              + ", ".join(regressions))
+        return 1
+    if compared == 0:
+        print("bench_compare: NOTHING COMPARED — no shared "
+              f"'{args.prefix}*' section with commensurable numbers; "
+              "the regression gate did not run (format drift? section "
+              "rename?)")
+        return 2
+    print(f"bench_compare: ok ({compared} section(s) compared)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
