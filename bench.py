@@ -499,7 +499,41 @@ def _hot_loop_metrics(snap: dict) -> dict:
         else 0.0,
         "conn_reused": snap.get("transport.conn.reused", 0),
         "conn_dialed": snap.get("transport.conn.dialed", 0),
+        # Round-collapse series (PR 8): how many writes took the
+        # collapsed path, how many fell back, how many in-round
+        # timestamp retries the optimistic leases cost, and whether any
+        # async tail failed to certify (tail_starved must be 0 on a
+        # healthy run).
+        "piggyback_ok": snap.get("client.piggyback.ok", 0),
+        "piggyback_fallback": snap.get("client.piggyback.fallback", 0),
+        "piggyback_retry_t": snap.get("client.piggyback.retry_t", 0),
+        "backfills": snap.get("client.write.backfill", 0),
+        "tail_starved": snap.get("client.tail.starved", 0),
     }
+
+
+def _round_breakdown(since_cursor: int) -> dict:
+    """Per-round write-latency breakdown, derived from the tracer ring
+    (the per-process half of the PR 7 stitched-trace plane): p50 of
+    every ``phase.*`` span recorded after ``since_cursor``.  Keys are
+    the round names — classic ``time``/``sign``/``write`` on the
+    fallback path, ``write_sign`` (the combined fan-out the caller
+    waits on) and ``ack`` (the async share/back-fill tail) on the
+    collapsed path — so the bench record shows exactly where a write's
+    wall-clock went."""
+    from bftkv_tpu import trace as trmod
+
+    spans = trmod.tracer.export(since_cursor)["spans"]
+    byname: dict[str, list[float]] = {}
+    for s in spans:
+        n = s["name"]
+        if n.startswith("phase."):
+            byname.setdefault(n[len("phase."):], []).append(s["duration"])
+    out = {}
+    for name, durs in sorted(byname.items()):
+        durs.sort()
+        out[name] = round(durs[len(durs) // 2], 4)
+    return out
 
 
 def _make_cluster(
@@ -624,7 +658,13 @@ def bench_cluster(
         # shape never exceeds the next power of two above dispatch_batch —
         # warming larger buckets would compile kernels the run cannot hit.
         _warm_dispatchers(clients, dispatch_batch)
+        for c in clients[:writers]:
+            if hasattr(c, "drain_tails"):
+                c.drain_tails()  # warmup tails stay out of the timed region
         metrics.reset()
+        from bftkv_tpu import trace as _trmod
+
+        trace_cur0 = _trmod.tracer.cursor()
 
         errors: list = []
         reads_by_thread = [0] * writers
@@ -691,6 +731,13 @@ def bench_cluster(
         elapsed = time.perf_counter() - t0
         if errors:
             raise errors[0]
+        # Quiesce the async write tails before the snapshot: elapsed
+        # (and writes/s) measure time-to-commit — the client contract —
+        # while the back-fill/starvation counters below must reflect a
+        # settled cluster, not a race with the snapshot.
+        for c in clients[:writers]:
+            if hasattr(c, "drain_tails"):
+                c.drain_tails()
 
         total_writes = writers * writes_per_writer - sum(conflicts_by_thread)
         total_reads = sum(reads_by_thread)
@@ -739,6 +786,7 @@ def bench_cluster(
         if zipf > 0:
             res["zipf_s"] = zipf
             res["write_conflicts"] = sum(conflicts_by_thread)
+        res["round_p50_s"] = _round_breakdown(trace_cur0)
         res.update(_hot_loop_metrics(snap))
         return res
     finally:
@@ -959,7 +1007,13 @@ def bench_cluster_shards(
                         seen.add(si)
                         c.write(key, value)
                     k += 1
+            for c in clients[:writers]:
+                if hasattr(c, "drain_tails"):
+                    c.drain_tails()
             metrics.reset()
+            from bftkv_tpu import trace as _trmod
+
+            trace_cur0 = _trmod.tracer.cursor()
 
             errors: list = []
             conflicts = [0] * writers
@@ -998,6 +1052,9 @@ def bench_cluster_shards(
             elapsed = time.perf_counter() - t0
             if errors:
                 raise errors[0]
+            for c in clients[:writers]:
+                if hasattr(c, "drain_tails"):
+                    c.drain_tails()
             writes_ok = writers * writes_per_writer - sum(conflicts)
             got = clients[0].read(b"bench/0/%d" % (writes_per_writer - 1)
                                   if zipf_probs is None else b"bench/warm/0/0")
@@ -1047,8 +1104,16 @@ def bench_cluster_shards(
                 ),
                 "quorum_cache_hits": snap.get("quorum.cache.hits", 0),
                 "quorum_cache_misses": snap.get("quorum.cache.misses", 0),
+                "round_p50_s": _round_breakdown(trace_cur0),
                 "setup_s": round(setup_s, 1),
             }
+            entry.update(
+                {
+                    k: v
+                    for k, v in _hot_loop_metrics(snap).items()
+                    if k.startswith(("piggyback", "backfills", "tail"))
+                }
+            )
             if zipf > 0:
                 entry["zipf_s"] = zipf
                 entry["write_conflicts"] = sum(conflicts)
@@ -1722,7 +1787,16 @@ def _compact_extra(extra: dict, configs: list, headline_from) -> dict:
             ),
             None,
         )
-        sections[name] = [status, num] if num is not None else status
+        # Cluster sections additionally carry write p50 as a third
+        # element, so the driver round records gate LATENCY regressions
+        # too (tools/bench_compare.py; two-element records stay valid).
+        p50 = sec.get("write_p50_s")
+        if num is not None and isinstance(p50, (int, float)) and p50 > 0:
+            sections[name] = [status, num, p50]
+        elif num is not None:
+            sections[name] = [status, num]
+        else:
+            sections[name] = status
     out = {
         "backend": extra.get("backend"),
         "jax": extra.get("jax"),

@@ -65,6 +65,7 @@ from bftkv_tpu.errors import (
     ERR_INVALID_TRANSPORT_SECURITY_DATA,
     ERR_UNKNOWN_SESSION,
 )
+from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.packet import read_chunk, write_chunk
 
 # The host ``cryptography`` library accelerates the RSA-OAEP key wrap
@@ -141,11 +142,9 @@ def _oaep_unwrap_py(key: rsa.PrivateKey, blob: bytes) -> bytes:
     c = int.from_bytes(blob, "big")
     if len(blob) != k or c >= key.n:
         raise ValueError("oaep: malformed ciphertext")
-    # CRT decrypt, ~4x a straight pow on host.
-    m1 = pow(c, key.d % (key.p - 1), key.p)
-    m2 = pow(c, key.d % (key.q - 1), key.q)
-    h = (pow(key.q, -1, key.p) * (m1 - m2)) % key.p
-    em = (m2 + h * key.q).to_bytes(k, "big")
+    # CRT decrypt (native Montgomery modexp when built — the bootstrap
+    # envelope's private op rides the same primitive as signing).
+    em = rsa.crt_pow_d(c, key).to_bytes(k, "big")
     masked_seed, masked_db = em[1 : 1 + _HLEN], em[1 + _HLEN :]
     seed = _bxor(masked_seed, _mgf1(masked_db, _HLEN))
     db = _bxor(masked_db, _mgf1(seed, k - _HLEN - 1))
@@ -227,6 +226,15 @@ class MessageSecurity:
         this when the peer reports ERR_UNKNOWN_SESSION)."""
         with self._lock:
             self._by_peer.pop(peer_id, None)
+
+    def has_session(self, peer_id: int) -> bool:
+        """Whether a message to ``peer_id`` would take the session fast
+        path — the presession pump's cold-peer probe (a stale-but-
+        present session still answers True; staleness is only learnable
+        from the peer's ERR_UNKNOWN_SESSION, which the transport heals
+        with a single-peer reseal)."""
+        with self._lock:
+            return peer_id in self._by_peer
 
     def _sessions_for(self, recipients) -> list[_SessionOut] | None:
         with self._lock:
@@ -335,6 +343,11 @@ class MessageSecurity:
         return out.getvalue()
 
     def _encrypt_bootstrap(self, recipients, plaintext, nonce) -> bytes:
+        # One observable per per-recipient asymmetric wrap: the series
+        # the stale-session tests (and the presession pump) watch to
+        # prove a single cold peer no longer re-bootstraps a whole
+        # group (tests/test_message_sessions.py).
+        metrics.incr("crypto.session.bootstrap_wraps", len(recipients))
         # Fresh pairwise sessions for every recipient of this envelope.
         grants = io.BytesIO()
         new_sessions: list[tuple[int, _SessionOut, certmod.Certificate]] = []

@@ -28,6 +28,8 @@ __all__ = [
     "store_unverified",
     "batch_sign_anything",
     "batch_store_unverified",
+    "write_sign_anything",
+    "batch_time_skew",
     "stale_replay_read",
     "make_colluder",
     "make_stale_replayer",
@@ -81,6 +83,37 @@ def batch_store_unverified(server, cmd, req, peer, sender):
     return pkt.serialize_results(results)
 
 
+def write_sign_anything(server, cmd, req, peer, sender):
+    """The round-collapsed write facing the colluder: sign whatever
+    arrives AND store it unverified, acking with a genuine share —
+    the piggybacked analog of sign_anything + store_unverified.  The
+    honest quorum's checks (strict timestamps, equivocation-free share
+    issuance, collective verification against the owner quorum) are
+    what keep this harmless, which is exactly what the chaos checker
+    asserts."""
+    p = pkt.parse(req)
+    share = server.crypt.collective.sign(server.crypt.signer, pkt.tbss(req))
+    mal_write = getattr(server.storage, "mal_write", None)
+    if mal_write is not None:
+        mal_write(p.variable or b"", p.t, req)
+    else:
+        server.storage.write(p.variable or b"", p.t, req)
+    return pkt.serialize_ws_ack(share=pkt.serialize_signature(share))
+
+
+def batch_time_skew(server, cmd, req, peer, sender):
+    """Answer every batched TIME item with a wildly inflated
+    timestamp — the Byzantine clock answer a reader's max() absorbs
+    (timestamps only order versions; a jump is legal, a rollback is
+    what the monotonicity invariant forbids).  Also the colluder's
+    guaranteed-manifest surface: BATCH_TIME fans to the FULL quorum,
+    while the staged WRITE_SIGN/SIGN waves may never ask a replica
+    outside the minimal prefix at all."""
+    items = pkt.parse_list(req)
+    fake = (1 << 40).to_bytes(8, "big")
+    return pkt.serialize_results([(None, fake)] * len(items))
+
+
 def stale_replay_read(server, cmd, req, peer, sender):
     """Answer a read with the OLDEST completed version — a genuinely
     signed but stale record.  An honest reader's deterministic
@@ -108,6 +141,8 @@ COLLUDER_HANDLERS = {
     "write": store_unverified,
     "batch_sign": batch_sign_anything,
     "batch_write": batch_store_unverified,
+    "write_sign": write_sign_anything,
+    "batch_time": batch_time_skew,
 }
 
 

@@ -183,6 +183,31 @@ class Nemesis:
             if hasattr(cl.qs, "shard_count")
             else 1
         )
+        # One small write_many per shard: the batched pipeline keeps
+        # the classic BATCH_TIME/SIGN/WRITE rounds, which is the only
+        # remaining traffic that crosses ``server.time`` — without it a
+        # clock-skew fault could never manifest under collapsed single
+        # writes (same honesty rule as stale_replay's storage-plane
+        # scoping: an uncrossed fault is undetectable by construction).
+        for s in range(nsh) if (shard_of and nsh > 1) else [None]:
+            batch: list[tuple[bytes, bytes]] = []
+            i = 0
+            while len(batch) < 2 and i < 4096:
+                v = f"chaos/{tag}/batch/{s}/{i}".encode()
+                i += 1
+                if s is None or shard_of(v) == s:
+                    batch.append((v, f"batch-{tag}-{i}".encode()))
+            try:
+                res = cl.write_many(batch)
+            except Exception as e:
+                res = [e] * len(batch)
+            for (v, val), err in zip(batch, res):
+                if err is None:
+                    rec.write_ok(cname, v, val)
+                    self._written[v] = val
+                else:
+                    rec.write_fail(cname, v, err)
+                    self.failures["write"] += 1
         if shard_of is not None and nsh > 1:
             covered = {
                 shard_of(f"chaos/{tag}/{i}".encode())
@@ -438,6 +463,14 @@ class Nemesis:
                 )
             except Exception as e:
                 self.cluster.recorder.read_fail("u01", once_var, e)
+            # Collapsed writes certify on an async tail; quiesce every
+            # client's tails before convergence + the final safety
+            # check, so "back-fill still in flight" can never be
+            # mistaken for a violation (or mask one).
+            for cl in self.cluster.clients:
+                drain = getattr(cl, "drain_tails", None)
+                if drain is not None:
+                    drain()
             converged = self.converge()
             trace = self.registry.trace()
             if self.collector is not None:

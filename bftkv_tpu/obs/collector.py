@@ -53,6 +53,10 @@ ANOMALY_COUNTERS = {
     "server.verify.collective_fail": "collective_verify_fail",
     "transport.peer.opens": "peer_circuit_open",
     "faults.fired": "fault_injected",
+    # A committed piggybacked write whose async tail never reached a
+    # verifying ``suff`` share set: the record stays commit-pending
+    # until a reader certifies it — worth an operator's attention.
+    "client.tail.starved": "tail_starved",
 }
 
 

@@ -400,6 +400,46 @@ def parse_bucket_ids(data: bytes) -> list[int]:
     return out
 
 
+# -- round-collapsed write ack (piggyback plane; no reference analog) ------
+# A WRITE_SIGN responder answers with ONE of:
+#   accept:  0x00 | serialized SignaturePacket share (empty for a
+#            storage-plane node that holds no seat in the sign quorum —
+#            its ack counts toward the write threshold only);
+#   decline: 0x01 | u64 stored_t — the responder's current timestamp
+#            for the variable.  The client's optimistic timestamp was
+#            stale; it retries the SAME round at max(stored_t)+1, which
+#            is what lets the separate TIME round disappear from the
+#            steady-state write.  A decline is NOT an error reply: the
+#            legacy error tunnel (x-error header) carries no payload,
+#            and the hint is the whole point.
+
+WS_ACCEPT = 0
+WS_DECLINE_T = 1
+
+
+def serialize_ws_ack(
+    share: bytes | None = None, decline_t: int | None = None
+) -> bytes:
+    if decline_t is not None:
+        return bytes([WS_DECLINE_T]) + struct.pack(">Q", decline_t)
+    return bytes([WS_ACCEPT]) + (share or b"")
+
+
+def parse_ws_ack(data: bytes) -> tuple[int, bytes, int]:
+    """``(status, share_bytes, stored_t)``; the irrelevant half of the
+    pair is ``b""`` / ``0``.  Anything malformed is a protocol error —
+    acks come from untrusted peers."""
+    if not data:
+        raise ERR_MALFORMED_REQUEST
+    if data[0] == WS_ACCEPT:
+        return WS_ACCEPT, data[1:], 0
+    if data[0] == WS_DECLINE_T:
+        if len(data) != 9:
+            raise ERR_MALFORMED_REQUEST
+        return WS_DECLINE_T, b"", struct.unpack(">Q", data[1:])[0]
+    raise ERR_MALFORMED_REQUEST
+
+
 # -- trace-context envelope (observability plane; no reference analog) -----
 # The transport fan-out prepends this to the PLAINTEXT payload before
 # session encryption, so a request's trace context crosses nodes (and

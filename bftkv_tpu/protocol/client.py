@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import logging
 import queue
+import random as _random
 import threading
 import time
 
@@ -44,6 +45,8 @@ from bftkv_tpu.errors import (
     ERR_INVALID_TIMESTAMP,
     ERR_MALFORMED_REQUEST,
     ERR_NO_AUTHENTICATION_DATA,
+    ERR_NO_MORE_WRITE,
+    ERR_UNKNOWN_COMMAND,
 )
 from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.protocol import MAX_UINT64, Protocol, Ref, majority_error
@@ -60,6 +63,38 @@ import os as _os
 _STAGED_SIGN_FANOUT = (
     _os.environ.get("BFTKV_SIGN_FANOUT", "staged") != "full"
 )
+
+#: Round-collapsed writes: ONE WRITE_SIGN fan-out replaces the classic
+#: time → sign → write rounds; the collective-signature shares ride the
+#: acks, the client commits at the write threshold, and the combined
+#: signature back-fills on the async tail (DESIGN.md §12).
+#: ``BFTKV_PIGGYBACK=off`` restores the classic rounds.
+_PIGGYBACK = _os.environ.get("BFTKV_PIGGYBACK", "on").lower() not in (
+    "off", "0", "false",
+)
+
+#: Retries of the combined round on stale-timestamp declines before
+#: giving the write to the classic path (each retry consumed one
+#: quorum hint, so loops mean a genuine write race).
+_WS_RETRIES = 3
+
+
+class _PiggybackFallback(Exception):
+    """Internal: this write must re-run on the classic three-round path
+    (legacy peers in the quorum, or a persistent timestamp race)."""
+
+
+def _interleave(a: list, b: list) -> list:
+    """a1 b1 a2 b2 ... — puts a minimal commit prefix (sign-quorum
+    threshold + write-plane threshold) at the head of the inline
+    fan-out, so the caller unblocks after the fewest possible posts."""
+    out: list = []
+    for i in range(max(len(a), len(b))):
+        if i < len(a):
+            out.append(a[i])
+        if i < len(b):
+            out.append(b[i])
+    return out
 
 #: write_many pipelining: at most this many chunk write-rounds in
 #: flight behind the caller thread's time+sign rounds (1 disables).
@@ -86,6 +121,100 @@ def _staged_wave(qa) -> tuple[list, list]:
             if qa.is_sufficient(prefix):
                 return prefix, nodes[len(prefix) :]
     return nodes, []
+
+
+class _BackfillCoalescer:
+    """Batches the async back-fill of certified records into shared
+    BATCH_WRITE rounds.
+
+    Every committed collapsed write owes the write plane one delivery
+    of its certified record.  Done per write that is a 4-post WRITE
+    round — ~40% of the whole write's post budget.  Concurrent writers
+    instead enqueue here; one daemon flusher drains the queue with a
+    tiny linger, groups records by owning shard (a BATCH_WRITE frame
+    must be single-shard: servers verify it against one owner quorum),
+    and delivers each group as ONE batched round whose collective
+    signatures the servers verify in one device batch.  ``drain()``
+    blocks until everything submitted has been delivered — the
+    quiescence hook behind ``Client.drain_tails``."""
+
+    LINGER = 0.003
+    MAX_BATCH = 128
+
+    def __init__(self, client):
+        self.client = client
+        self._q: "queue.SimpleQueue[tuple[bytes, bytes]]" = (
+            queue.SimpleQueue()
+        )
+        self._cv = threading.Condition()
+        self._pending = 0
+        self._thread: threading.Thread | None = None
+
+    def submit(self, variable: bytes, record: bytes) -> None:
+        with self._cv:
+            self._pending += 1
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run, daemon=True, name="bftkv-backfill"
+                )
+                self._thread.start()
+        self._q.put((variable, record))
+
+    def drain(self, timeout: float | None = 30.0) -> None:
+        with self._cv:
+            self._cv.wait_for(
+                lambda: self._pending == 0, timeout=timeout
+            )
+
+    def _run(self) -> None:
+        while True:
+            try:
+                batch = [self._q.get(timeout=5.0)]
+            except queue.Empty:
+                continue  # daemon thread: cheap to keep parked
+            deadline = time.monotonic() + self.LINGER
+            while len(batch) < self.MAX_BATCH:
+                try:
+                    batch.append(
+                        self._q.get(
+                            timeout=max(0.0, deadline - time.monotonic())
+                        )
+                    )
+                except queue.Empty:
+                    break
+            try:
+                self._flush(batch)
+            except Exception:
+                log.exception("back-fill flush failed")
+            finally:
+                with self._cv:
+                    self._pending -= len(batch)
+                    self._cv.notify_all()
+
+    def _flush(self, batch: list[tuple[bytes, bytes]]) -> None:
+        # Group by owning shard: all phases of one record must agree
+        # on the clique, and a BATCH_WRITE frame is verified against
+        # one owner quorum server-side.
+        shard_of = getattr(self.client.qs, "shard_of", None)
+        groups: dict[object, list[tuple[bytes, bytes]]] = {}
+        for variable, record in batch:
+            key = shard_of(variable) if shard_of is not None else None
+            groups.setdefault(key, []).append((variable, record))
+        for items in groups.values():
+            qw = qm.choose_quorum_for(
+                self.client.qs, items[0][0], qm.WRITE
+            )
+            with trace.span(
+                "backfill.flush", attrs={"batch": len(items)}
+            ):
+                self.client.tr.multicast(
+                    tp.BATCH_WRITE,
+                    qw.nodes(),
+                    pkt.serialize_list([rec for _v, rec in items]),
+                    None,
+                )
+            metrics.incr("client.write.backfill", len(items))
+            metrics.observe("client.backfill.batch", len(items))
 
 
 class _SignedValue:
@@ -234,6 +363,45 @@ class _shard_timer:
 
 
 class Client(Protocol):
+    def __init__(self, self_node, qs, tr, crypt):
+        super().__init__(self_node, qs, tr, crypt)
+        from bftkv_tpu.crypto.presession import Presession
+
+        #: Presession material (timestamp leases, warm sessions, signer
+        #: maps) — the offline half of the round-collapsed write.
+        self._presession = Presession(self)
+        #: Peers that answered ERR_UNKNOWN_COMMAND to WRITE_SIGN: old
+        #: servers.  A quorum containing one runs the classic rounds.
+        self._legacy_peers: set[int] = set()
+        #: Outstanding async write tails (certify-repair pushes) and
+        #: the back-fill coalescer; ``drain_tails`` quiesces both —
+        #: benches, the chaos checker, and tests use it.
+        self._tails: list[threading.Thread] = []
+        self._tails_lock = threading.Lock()
+        self._backfills = _BackfillCoalescer(self)
+
+    def drain_tails(self, timeout: float | None = 30.0) -> None:
+        """Quiesce every outstanding async write tail (bounded)."""
+        self._backfills.drain(timeout)
+        with self._tails_lock:
+            tails = list(self._tails)
+        deadline = (
+            None if timeout is None else time.monotonic() + timeout
+        )
+        for th in tails:
+            th.join(
+                None
+                if deadline is None
+                else max(0.0, deadline - time.monotonic())
+            )
+        with self._tails_lock:
+            self._tails = [t for t in self._tails if t.is_alive()]
+
+    def _track_tail(self, th: threading.Thread) -> None:
+        with self._tails_lock:
+            self._tails = [t for t in self._tails if t.is_alive()]
+            self._tails.append(th)
+
     def _shard_label(self, variable: bytes) -> int | None:
         """The owning shard of ``variable`` for metric labels/span
         attrs — None when the namespace is unsharded (no label: the
@@ -249,8 +417,14 @@ class Client(Protocol):
     # -- write path (reference: client.go:62-170) -------------------------
 
     def write(self, variable: bytes, value: bytes, proof=None) -> None:
-        """Three-phase signed write: collect timestamps from a READ|AUTH
-        quorum, then sign + store (reference: client.go:62-92)."""
+        """Signed write.  Steady state is the round-collapsed path: ONE
+        WRITE_SIGN fan-out (timestamp from the presession lease, shares
+        piggybacked on the acks, commit at the write threshold, the
+        collective signature back-filled on the async tail).  The
+        classic three rounds — collect timestamps from a READ|AUTH
+        quorum, then sign + store (reference: client.go:62-92) — remain
+        as the fallback for legacy quorums, persistent write races, and
+        ``BFTKV_PIGGYBACK=off``."""
         shard = self._shard_label(variable)
         attrs = {"value_bytes": len(value)}
         if shard is not None:
@@ -258,6 +432,13 @@ class Client(Protocol):
         with _shard_timer("client.write.latency", shard), trace.span(
             "client.write", attrs=attrs
         ):
+            if self._piggyback_ok(variable):
+                try:
+                    self._write_piggyback(variable, value, proof)
+                    metrics.incr("client.write.ok")
+                    return
+                except _PiggybackFallback:
+                    metrics.incr("client.piggyback.fallback")
             with trace.span("quorum.select"):
                 qr = qm.choose_quorum_for(self.qs, variable, qm.READ | qm.AUTH)
             maxt = 0
@@ -286,7 +467,18 @@ class Client(Protocol):
 
     def write_once(self, variable: bytes, value: bytes, proof=None) -> None:
         """t = 2^64-1 marks the value immutable forever
-        (reference: client.go:90-92)."""
+        (reference: client.go:90-92).  No timestamp discovery is needed
+        in either shape — the ceiling either wins or the variable is
+        already sealed — so the collapsed path needs exactly one round
+        here too."""
+        if self._piggyback_ok(variable):
+            try:
+                self._write_piggyback(
+                    variable, value, proof, t_fixed=MAX_UINT64
+                )
+                return
+            except _PiggybackFallback:
+                metrics.incr("client.piggyback.fallback")
         self._write_with_timestamp(variable, value, MAX_UINT64, proof)
 
     def _write_with_timestamp(
@@ -376,6 +568,263 @@ class Client(Protocol):
                 except Exception as e:
                     raise majority_error(errs, e)
             return sig, ss
+
+    # -- round-collapsed write (piggyback; DESIGN.md §12) ------------------
+
+    def _piggyback_ok(self, variable: bytes) -> bool:
+        """Whether this write may take the collapsed path: the feature
+        is on and no quorum member is a known legacy server."""
+        if not _PIGGYBACK:
+            return False
+        if not self._legacy_peers:
+            return True
+        qa = qm.choose_quorum_for(self.qs, variable, qm.AUTH | qm.PEER)
+        qw = qm.choose_quorum_for(self.qs, variable, qm.WRITE)
+        return not any(
+            n.id in self._legacy_peers for n in qa.nodes() + qw.nodes()
+        )
+
+    def _write_piggyback(
+        self, variable: bytes, value: bytes, proof, t_fixed: int | None = None
+    ) -> None:
+        """The collapsed write: optimistic timestamp from the lease,
+        one combined WRITE_SIGN round, bounded decline-driven retries.
+        Raises ``_PiggybackFallback`` when the classic rounds must take
+        over (legacy peers; a write race outlasting the retry budget)."""
+        t = t_fixed if t_fixed is not None else self._presession.next_t(
+            variable
+        )
+        for attempt in range(_WS_RETRIES + 1):
+            status, arg = self._ws_round(variable, value, t, proof)
+            if status == "commit":
+                metrics.incr("client.piggyback.ok")
+                self._presession.lease_update(variable, t)
+                return
+            if status == "retry" and t_fixed is None:
+                # Stale lease: the quorum answered with its stored
+                # timestamps; retry ONE past the highest.  This in-round
+                # exchange is what replaced the TIME round.  A hint AT
+                # our own guess means a live racer — jitter before
+                # retrying, or two lockstep writers can split the
+                # clique 2f+1-less forever (the legacy rounds broke the
+                # tie by failing one writer's sign outright; declines
+                # are gentler, so the tie-break must be explicit).
+                metrics.incr("client.piggyback.retry_t")
+                self._presession.lease_update(variable, arg)
+                if arg >= t:
+                    time.sleep(_random.random() * 0.004 * (attempt + 1))
+                t = arg + 1
+                continue
+            if status == "fallback":
+                raise _PiggybackFallback
+            if status == "retry":
+                # t_fixed is set (write_once): an honest replica never
+                # declines t = 2^64-1, so a hint here is a Byzantine or
+                # inconsistent answer — give the write to the classic
+                # rounds rather than looping on a fixed timestamp.
+                raise _PiggybackFallback
+            if t_fixed is None and arg == ERR_NO_MORE_WRITE:
+                # Keep the client contract of the classic rounds: a
+                # normal write of a sealed (write-once) variable fails
+                # with the TIME phase's ERR_INVALID_TIMESTAMP
+                # (reference: client.go:85-87).
+                raise ERR_INVALID_TIMESTAMP
+            raise arg
+        raise _PiggybackFallback  # persistent race: let TIME arbitrate
+
+    def _ws_round(
+        self, variable: bytes, value: bytes, t: int, proof
+    ) -> tuple[str, object]:
+        """One combined round, driven on the CALLER thread.
+
+        The fan-out asks a minimal *wave* first — the shortest prefix of
+        the interleaved sign∪write quorum whose full success already
+        commits (2f+1 clique + write-plane threshold) AND reaches
+        ``suff`` shares — so the steady state costs exactly one
+        private-key op per wave-1 clique member, same as the classic
+        staged sign round, with zero separate TIME/WRITE rounds.  The
+        remainder is asked only on shortfall (a failed or declining
+        wave-1 member), mirroring ``_staged_wave``.
+
+        On commit the tail is CHEAP — mint + one ~0.2 ms verify — and
+        the certified record is handed to the back-fill coalescer
+        (one batched BATCH_WRITE round amortized over concurrent
+        writes); only the rare shortfall path spawns a thread.  Returns
+        ``("commit", t) | ("retry", max stored-t hint) |
+        ("fallback", None) | ("fail", error)``."""
+        tbs = pkt.serialize(variable, value, t, nfields=3)
+        sig = self.crypt.signer.issue(tbs)
+        tbss = pkt.serialize(variable, value, t, sig, nfields=4)
+        req = pkt.serialize(variable, value, t, sig, proof)
+
+        with trace.span("quorum.select"):
+            qa = qm.choose_quorum_for(
+                self.qs, variable, qm.AUTH | qm.PEER
+            )
+            qw = qm.choose_quorum_for(self.qs, variable, qm.WRITE)
+        qa_nodes = qa.nodes()
+        qa_ids = {n.id for n in qa_nodes}
+        extra = [n for n in qw.nodes() if n.id not in qa_ids]
+        nodes = _interleave(qa_nodes, extra)
+        self._presession.note_peers(nodes)
+        self._presession.ensure_pump()
+        smap = self._presession.signer_map(qa)
+
+        acks: list = []
+        entries: dict[int, bytes] = {}
+        extra_certs: dict[int, object] = {}
+        fails: list = []
+        errs: list = []
+        hints: list[int] = []
+        legacy: list = []
+
+        def add_share(share_bytes: bytes) -> None:
+            try:
+                share = pkt.parse_signature(share_bytes)
+                if share is None:
+                    return
+                if share.cert:
+                    for c in certmod.parse(share.cert):
+                        if self.crypt.keyring.get(c.id) is None:
+                            extra_certs.setdefault(c.id, c)
+                for sid, sb in sigmod.parse_entries(share.data):
+                    if sid in smap or sid in extra_certs:
+                        entries.setdefault(sid, sb)
+            except Exception:
+                return  # an unparsable share is simply not counted
+
+        def committed() -> bool:
+            return qa.is_threshold(acks) and qw.is_threshold(acks)
+
+        def share_certs() -> list:
+            out = []
+            for sid in entries:
+                c = smap.get(sid) or extra_certs.get(sid)
+                if c is not None:
+                    out.append(c)
+            return out
+
+        def cb(res: tp.MulticastResponse) -> bool:
+            err = res.err
+            if err is None and res.data is not None:
+                try:
+                    status, share_bytes, stored_t = pkt.parse_ws_ack(
+                        res.data
+                    )
+                except Exception as e:
+                    err = e
+                else:
+                    if status == pkt.WS_DECLINE_T:
+                        hints.append(stored_t)
+                        errs.append(ERR_INVALID_TIMESTAMP())
+                        fails.append(res.peer)
+                    else:
+                        acks.append(res.peer)
+                        if share_bytes:
+                            add_share(share_bytes)
+                    return False
+            if err == ERR_UNKNOWN_COMMAND:
+                legacy.append(res.peer)
+                self._legacy_peers.add(res.peer.id)
+            errs.append(err)
+            fails.append(res.peer)
+            return False  # consume the wave: every response carries state
+
+        wave1, rest = nodes, []
+        if _STAGED_SIGN_FANOUT:
+            for i in range(1, len(nodes) + 1):
+                prefix = nodes[:i]
+                if (
+                    qa.is_threshold(prefix)
+                    and qw.is_threshold(prefix)
+                    and qa.is_sufficient(prefix)
+                ):
+                    wave1, rest = prefix, nodes[i:]
+                    break
+
+        with trace.span(
+            "phase.write_sign", attrs={"peers": len(wave1)}
+        ):
+            self.tr.multicast(tp.WRITE_SIGN, wave1, req, cb)
+        if rest and not (
+            committed() and qa.is_sufficient(share_certs())
+        ):
+            # Shortfall: expand to the remainder (the staged sign
+            # round's second wave, collapsed-path form).
+            metrics.incr("client.piggyback.expanded")
+            with trace.span(
+                "phase.write_sign", attrs={"peers": len(rest), "wave": 2}
+            ):
+                self.tr.multicast(tp.WRITE_SIGN, rest, req, cb)
+
+        if not committed():
+            if legacy:
+                return ("fallback", None)
+            if hints:
+                return ("retry", max(hints))
+            return (
+                "fail",
+                majority_error(
+                    [e for e in errs if e is not None],
+                    ERR_INSUFFICIENT_NUMBER_OF_RESPONSES,
+                ),
+            )
+
+        # Committed.  Finish the tail: mint + verify + batched
+        # back-fill — sub-millisecond next to the round itself, so it
+        # runs inline; the coalescer carries the network round.
+        self._ws_finish(
+            variable, value, t, sig, tbss, qa, smap, entries, extra_certs
+        )
+        return ("commit", t)
+
+    def _ws_finish(
+        self, variable, value, t, sig, tbss, qa, smap, entries,
+        extra_certs,
+    ) -> None:
+        """Mint the collective signature from the piggybacked shares,
+        verify it against the sign quorum (``suff`` signers — the wotqs
+        math is untouched), and hand the certified record to the
+        back-fill coalescer.  A share set that cannot reach a verifying
+        ``suff`` is surfaced as ``client.tail.starved`` — the fleet
+        collector turns that counter into an anomaly (note ``n − f ≥
+        suff`` for every clique size: clean crashes within the fault
+        budget cannot starve a tail, only misbehavior can — the round
+        itself would have failed first)."""
+        with trace.span("phase.ack", attrs={"shares": len(entries)}):
+            signers_ = [
+                smap.get(sid) or extra_certs.get(sid) for sid in entries
+            ]
+            if not qa.is_sufficient([c for c in signers_ if c is not None]):
+                metrics.incr("client.tail.starved")
+                log.warning(
+                    "write tail starved: %d shares never reached suff "
+                    "for %r (t=%d)", len(entries), variable, t,
+                )
+                return
+            embeds = list(extra_certs.values())
+            ss = pkt.SignaturePacket(
+                type=pkt.SIGNATURE_TYPE_NATIVE,
+                version=1,
+                completed=True,
+                data=sigmod.serialize_entries(list(entries.items())),
+                cert=certmod.serialize_many(embeds) if embeds else None,
+            )
+            with trace.span("verify.collective"):
+                try:
+                    self.crypt.collective.verify(
+                        tbss, ss, qa, self.crypt.keyring
+                    )
+                except Exception:
+                    metrics.incr("client.tail.starved")
+                    log.warning(
+                        "write tail starved: combined signature for %r "
+                        "(t=%d) failed verification", variable, t,
+                    )
+                    return
+            self._backfills.submit(
+                variable, pkt.serialize(variable, value, t, sig, ss)
+            )
 
     # -- batched write pipeline (no reference analog) ---------------------
 
@@ -790,6 +1239,7 @@ class Client(Protocol):
                 resolved = self._resolve_complete_fanout_many(
                     ms, q, key=variables[0]
                 )
+                self._certify_resolved(ms, q, resolved, variables, proof)
             except Exception as e:
                 for k in range(n):
                     fails[k].append(e)
@@ -800,6 +1250,7 @@ class Client(Protocol):
                 if resolved[k] is not None:
                     value, maxt = resolved[k]
                     results.append(value)
+                    self._presession.lease_update(variables[k], maxt)
                     winners.append((k, value, maxt))
                 else:
                     results.append(
@@ -892,7 +1343,7 @@ class Client(Protocol):
 
             worker = threading.Thread(
                 target=self._read_worker,
-                args=(q, req, ch, variable, trace.capture()),
+                args=(q, req, ch, variable, trace.capture(), proof),
                 daemon=True,
             )
             worker.start()
@@ -902,14 +1353,16 @@ class Client(Protocol):
             return value
 
     def _read_worker(
-        self, q, req: bytes, ch, variable: bytes, tctx=None
+        self, q, req: bytes, ch, variable: bytes, tctx=None, proof=None
     ) -> None:
         # The fan-out runs on this worker thread; re-attach the read's
         # trace context so per-peer rpc spans join the caller's trace.
         with trace.attach(tctx):
-            self._read_worker_inner(q, req, ch, variable)
+            self._read_worker_inner(q, req, ch, variable, proof)
 
-    def _read_worker_inner(self, q, req: bytes, ch, variable: bytes) -> None:
+    def _read_worker_inner(
+        self, q, req: bytes, ch, variable: bytes, proof=None
+    ) -> None:
         m: dict[int, dict[bytes, list[_SignedValue]]] = {}
         done = False
         value = None
@@ -947,11 +1400,16 @@ class Client(Protocol):
             # collective signature endorses a strictly newer candidate
             # (see _resolve_complete_fanout_many).
             try:
-                (res0,) = self._resolve_complete_fanout_many(
+                resolved = self._resolve_complete_fanout_many(
                     [m], q, key=variable
                 )
+                self._certify_resolved(
+                    [m], q, resolved, [variable], proof
+                )
+                (res0,) = resolved
                 if res0 is not None:
                     value, maxt = res0
+                    self._presession.lease_update(variable, maxt)
                     deliver(value, None)
             except Exception as e:
                 # The worker must ALWAYS deliver: an exception here
@@ -1086,6 +1544,158 @@ class Client(Protocol):
                     resolved[k] = ((val or None), t)
                     sig_won[k] = True
         return resolved
+
+    def _certify_resolved(
+        self, ms: list[dict], q, resolved: list, variables: list[bytes],
+        proof=None,
+    ) -> None:
+        """Commit-pending winners must leave the read CERTIFIED.
+
+        A bucket that won by responder threshold but holds only
+        commit-pending records (piggybacked writes whose collective
+        back-fill has not landed yet) is completed ON READ: one SIGN
+        round to the owner sign quorum re-collects shares for the exact
+        stored ``<x, v, t, sig>`` (idempotent at every honest replica —
+        they already signed it), the combined signature is verified,
+        and the winning bucket's repair packet is upgraded to the
+        certified bytes so read-repair spreads the completed record.
+        A pending bucket that CANNOT certify is demoted and the item
+        re-resolved without it — a bare value is never served
+        (DESIGN.md §12.3).  Mutates ``resolved`` in place."""
+        for k in range(len(resolved)):
+            demoted = False
+            while resolved[k] is not None:
+                value, t = resolved[k]
+                if not value:
+                    break  # empty read: nothing claimed, nothing to back
+                bucket = ms[k].get(t, {}).get(value or b"")
+                if not bucket or any(
+                    sv.ss is not None and sv.ss.completed for sv in bucket
+                ):
+                    break  # certified (or an empty t=0 resolution)
+                ss = self._certify_pending(variables[k], bucket, proof)
+                if ss is not None:
+                    metrics.incr("client.read.certified")
+                    base = pkt.parse(bucket[0].packet)
+                    certified = pkt.serialize(
+                        base.variable, base.value, base.t, base.sig, ss
+                    )
+                    bucket[0] = _SignedValue(
+                        bucket[0].node, base.sig, ss, certified
+                    )
+                    # Push the now-certified bytes to the read quorum on
+                    # an async tail: the regular read-repair skips nodes
+                    # that already "have" the value, but they only hold
+                    # the PENDING form — the upgrade must reach them or
+                    # the record would stay uncertified until the next
+                    # certify-on-read.  Idempotent at every replica
+                    # (same <t, value>, verified ss).  Bind the loop
+                    # locals as defaults: the k-loop rebinds them before
+                    # the thread runs when several items certify.
+                    nodes = list(q.nodes())
+                    th = threading.Thread(
+                        target=lambda ns=nodes, data=certified: (
+                            self.tr.multicast(tp.WRITE, ns, data, None)
+                        ),
+                        daemon=True,
+                        name="bftkv-certify-repair",
+                    )
+                    self._track_tail(th)
+                    th.start()
+                    break
+                # Unbackable pending bucket: demote it and re-resolve.
+                metrics.incr("client.read.pending_unbacked")
+                demoted = True
+                vl = ms[k].get(t)
+                if vl is not None:
+                    vl.pop(value or b"", None)
+                    if not vl:
+                        ms[k].pop(t, None)
+                resolved[k] = self._resolve_complete_fanout_many(
+                    [ms[k]], q, key=variables[k]
+                )[0]
+            if resolved[k] is None and demoted:
+                # Every candidate was an uncertifiable pending record —
+                # a replica serving a pending latest HIDES its previous
+                # certified version, so ask the quorum again for the
+                # latest CERTIFIED records only (read request t=1; old
+                # servers already behave that way).
+                resolved[k] = self._read_certified_only(
+                    variables[k], q, proof
+                )
+
+    def _read_certified_only(
+        self, variable: bytes, q, proof
+    ) -> tuple[bytes | None, int] | None:
+        """One certified-only read round (request ``t = 1``), resolved
+        over the complete fan-out; pending records cannot appear."""
+        metrics.incr("client.read.certified_fallback")
+        req = pkt.serialize(variable, None, 1, None, proof)
+        m: dict = {}
+
+        def cb(res: tp.MulticastResponse) -> bool:
+            self._process_response(res, m, variable)
+            return False
+
+        with trace.span("read.certified_only"):
+            self.tr.multicast(tp.READ, q.nodes(), req, cb)
+        try:
+            return self._resolve_complete_fanout_many(
+                [m], q, key=variable
+            )[0]
+        except Exception:
+            return None
+
+    def _certify_pending(
+        self, variable: bytes, bucket: list, proof
+    ) -> pkt.SignaturePacket | None:
+        """Collect a fresh collective signature for a commit-pending
+        record (helping: completing the in-flight write's tail from the
+        reader's seat).  Returns the verified ``ss`` or None."""
+        base = bucket[0].packet
+        if not base:
+            return None
+        try:
+            p = pkt.parse(base)
+        except Exception:
+            return None
+        if p.sig is None:
+            return None
+        qa = qm.choose_quorum_for(self.qs, variable, qm.AUTH | qm.PEER)
+        req = pkt.serialize(p.variable or b"", p.value, p.t, p.sig, proof)
+        tbss = pkt.tbss(base)
+        ss = None
+        done_flag = [False]
+        failure: list = []
+
+        def cb(res: tp.MulticastResponse) -> bool:
+            nonlocal ss
+            if res.err is None and res.data is not None:
+                try:
+                    share = pkt.parse_signature(res.data)
+                    ss, done = self.crypt.collective.combine(
+                        ss, share, qa, self.crypt.keyring
+                    )
+                    done_flag[0] = done
+                    return done
+                except Exception:
+                    pass
+            failure.append(res.peer)
+            return qa.reject(failure)
+
+        with trace.span("read.certify", attrs={"peers": len(qa.nodes())}):
+            wave1, rest = _staged_wave(qa)
+            self.tr.multicast(tp.SIGN, wave1, req, cb)
+            if not done_flag[0] and rest:
+                self.tr.multicast(tp.SIGN, rest, req, cb)
+            try:
+                self.crypt.collective.verify(
+                    tbss, ss, qa, self.crypt.keyring
+                )
+            except Exception:
+                return None
+        ss.completed = True
+        return ss
 
     def _write_back(self, universe, m, value: bytes, t: int) -> None:
         """Read-repair: push the winning packet to every node that did

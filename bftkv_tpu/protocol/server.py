@@ -298,9 +298,18 @@ class Server(Protocol):
 
     def _read(self, req: bytes, peer, sender) -> bytes | None:
         p = pkt.parse(req)
-        return self._read_item(p.variable or b"", p.ss)
+        # ``t == 1`` in a read request asks for the latest CERTIFIED
+        # record only (skip commit-pending) — the reader's fallback
+        # after a pending winner failed to certify.  Old servers ignore
+        # the request's t and never serve pending records, so the flag
+        # degrades to their behavior exactly.
+        return self._read_item(
+            p.variable or b"", p.ss, certified_only=(p.t == 1)
+        )
 
-    def _read_item(self, variable: bytes, proof) -> bytes | None:
+    def _read_item(
+        self, variable: bytes, proof, certified_only: bool = False
+    ) -> bytes | None:
         if variable.startswith(HIDDEN_PREFIX):
             raise ERR_PERMISSION_DENIED
         self._shard_check(variable)
@@ -313,7 +322,33 @@ class Server(Protocol):
         if raw is not None:
             stored = pkt.parse(raw)
             authenticated = stored.auth
-            if stored.ss is None or not stored.ss.completed:
+            if (
+                stored.ss is not None
+                and not stored.ss.completed
+                and certified_only
+            ):
+                # Scan back exactly as for a sign-phase record.
+                raw = None
+                for t in self._versions_below(variable, stored.t):
+                    try:
+                        candidate = self.storage.read(variable, t)
+                    except ERR_NOT_FOUND:
+                        continue
+                    cp = pkt.parse(candidate)
+                    if cp.ss is not None and cp.ss.completed:
+                        raw = candidate
+                        break
+            elif stored.ss is not None and not stored.ss.completed:
+                # Commit-pending piggyback record (WRITE_SIGN persists
+                # with a partial, non-completed ss; the legacy sign
+                # phase persists ss=None): SERVE it.  The client-side
+                # resolve accepts it only through the resolve path — a
+                # responder threshold plus certify-on-read when no
+                # completed collective signature is in the bucket — so
+                # a bare value is never served off one replica's word
+                # (DESIGN.md §12.3).
+                metrics.incr("server.read.pending")
+            elif stored.ss is None:
                 # A sign request arrived but the write never completed —
                 # scan back for the last completed version
                 # (reference: server.go:166-180).
@@ -508,8 +543,12 @@ class Server(Protocol):
                 except Exception:
                     raise ERR_AUTHENTICATION_FAILURE from None
             # Never sign both <x,t,v> and <x,t,v'>
-            # (reference: server.go:242-262).
-            if rp.t == MAX_UINT64:
+            # (reference: server.go:242-262).  Re-signing the EXACT
+            # stored <t, value> stays allowed even at the write-once
+            # ceiling: it issues no second signature over anything new,
+            # and it is how a reader certifies a commit-pending
+            # write-once record (client._certify_pending).
+            if rp.t == MAX_UINT64 and not (t == rp.t and val == rp.value):
                 raise ERR_NO_MORE_WRITE
             if t == rp.t and val != rp.value:
                 if self._revoke_signers(
@@ -560,17 +599,20 @@ class Server(Protocol):
                 raise
 
         out = self._write_storage_checks(variable, val, t, sig, ss, req)
-        self._persist(variable, t, out)
+        if out is not None:  # None = idempotent no-op (see checks)
+            self._persist(variable, t, out)
         metrics.incr("server.write.ok")
         return None
 
     def _write_storage_checks(
         self, variable, val, t, sig, ss, req, frame_embedded=None
-    ) -> bytes:
+    ) -> bytes | None:
         """The per-variable part of ``write``: write-once, timestamp,
         equivocation, and TOFU checks against the stored version
         (reference: server.go:314-345).  Returns the bytes to persist
-        (the request, with inherited auth params folded in).
+        (the request, with inherited auth params folded in), or
+        ``None`` for an idempotent no-op (a stale-version certification
+        already satisfied — see ``_stale_version_upgrade``).
 
         ``frame_embedded`` (id→cert) backstops TOFU issuer resolution
         for batch items whose sig carries no cert of its own (the
@@ -600,32 +642,271 @@ class Server(Protocol):
                     break
         if rdata is not None:
             rp = pkt.parse(rdata)
-            if rp.t == MAX_UINT64:
+            # The exact stored <t, value> is re-admittable even at the
+            # write-once ceiling: that is the back-fill certifying a
+            # commit-pending write-once record (and a read-repair
+            # re-delivering a completed one) — idempotent, not a
+            # second write.
+            if rp.t == MAX_UINT64 and not (t == rp.t and val == rp.value):
                 raise ERR_NO_MORE_WRITE
             if t < rp.t:
-                raise ERR_BAD_TIMESTAMP
+                # Below the latest stored version — USUALLY a stale
+                # write.  One case is not: the collective back-fill of
+                # a committed collapsed write arriving after a newer
+                # commit-PENDING version landed (a failed racer's
+                # residue, or simply the next write outrunning this
+                # one's async tail).  Certifying the exact version this
+                # replica already admitted at t must not be blocked, or
+                # residue at the top could starve the plane of ANY
+                # completed record (DESIGN.md §12.3).
+                return self._stale_version_upgrade(variable, val, t, out)
             if t == rp.t and val != rp.value:
                 if rp.ss is not None:
                     self._revoke_signers(
                         sigmod.signers(ss), sigmod.signers(rp.ss)
                     )
-                metrics.incr("server.equivocation")
-                raise ERR_EQUIVOCATION
+                if not (
+                    ss is not None
+                    and ss.completed
+                    and (rp.ss is None or not rp.ss.completed)
+                ):
+                    metrics.incr("server.equivocation")
+                    raise ERR_EQUIVOCATION
+                # A CERTIFIED record (its collective signature already
+                # verified by the caller) beats uncertified residue at
+                # the same timestamp: the quorum endorsed this value,
+                # the residue is a failed racer's leftovers — refusing
+                # would leave this replica permanently divergent.
+                # Double-signers were still swept above.
+                metrics.incr("server.write.residue_replaced")
 
-            # TOFU: the new issuer must match the previous issuer's id
-            # or uid (reference: server.go:329-337).
-            new_issuer = sigmod.issuer(sig, self.crypt.keyring, frame_embedded)
-            prev_issuer = sigmod.issuer(rp.sig, self.crypt.keyring, frame_embedded)
-            if (
-                prev_issuer.id != new_issuer.id
-                and prev_issuer.uid != new_issuer.uid
-            ):
-                raise ERR_PERMISSION_DENIED
+            # TOFU: the new issuer must match the CERTIFIED owner's id
+            # or uid (reference: server.go:329-337; residue never owns,
+            # see _tofu_prev_sig).
+            prev_sig = self._tofu_prev_sig(variable, rp)
+            if prev_sig is not None:
+                new_issuer = sigmod.issuer(
+                    sig, self.crypt.keyring, frame_embedded
+                )
+                prev_issuer = sigmod.issuer(
+                    prev_sig, self.crypt.keyring, frame_embedded
+                )
+                if (
+                    prev_issuer.id != new_issuer.id
+                    and prev_issuer.uid != new_issuer.uid
+                ):
+                    raise ERR_PERMISSION_DENIED
 
             if rp.auth is not None:  # inherit auth params
                 out = pkt.serialize(variable, val, t, sig, ss, rp.auth)
 
         return out
+
+    # -- round-collapsed write (piggyback; no reference analog) ------------
+
+    def _signs_for(self, variable: bytes) -> bool:
+        """Whether this replica holds a seat in the sign (AUTH) quorum
+        that owns ``variable`` — i.e. whether its WRITE_SIGN ack should
+        carry a collective-signature share.  Storage-plane complement
+        nodes ack without a share: their signatures could never count
+        toward ``suff`` anyway (is_sufficient tallies clique members
+        only), and skipping the private-key op keeps the write plane as
+        cheap as the legacy WRITE round."""
+        qa = qm.choose_quorum_for(self.qs, variable, qm.AUTH)
+        myid = self.self_node.get_self_id()
+        return any(n.id == myid for n in qa.nodes())
+
+    def _write_sign(self, req: bytes, peer, sender) -> bytes:
+        """ONE round carrying what sign + write did in two: verify the
+        writer (signature + quorum certificate), run the write-path
+        storage checks, persist the record as COMMIT-PENDING (partial
+        ss, completed=False), and piggyback this replica's collective-
+        signature share inside the ack (packet.serialize_ws_ack).
+
+        Timestamp admission is STRICT — the request's ``t`` must exceed
+        the stored timestamp (the sole exception: re-acking the exact
+        stored <t, value>, which keeps client retries idempotent).  A
+        stale optimistic guess is answered with a DECLINE hint carrying
+        the stored timestamp, never with a share and never with the
+        equivocation revocation: this replica refuses to sign at or
+        below its stored timestamp, so the "never sign both <x,t,v>
+        and <x,t,v'>" invariant holds by construction, and an honest
+        client whose lease went stale cannot be mistaken for a
+        Byzantine double-signer (DESIGN.md §12.2)."""
+        p = pkt.parse(req)
+        variable, val, t, sig, proof = (
+            p.variable or b"", p.value, p.t, p.sig, p.ss,
+        )
+        if sig is None:
+            raise ERR_MALFORMED_REQUEST
+        if variable.startswith(HIDDEN_PREFIX):
+            raise ERR_PERMISSION_DENIED
+        self._shard_check(variable)
+
+        # Writer authentication, exactly as the sign phase does it.
+        issuer = sigmod.issuer(sig, self.crypt.keyring)
+        tbs = pkt.tbs(req)
+        with trace.span(
+            "server.verify_batch",
+            attrs={"batch_size": 1, "kind": "writer_sig"},
+        ):
+            sigmod.verify_with_certificate(tbs, sig, issuer)
+        signs = self._signs_for(variable)
+        if signs:
+            # Quorum-certificate check: sign-seat holders only.  A
+            # storage-plane node's distance-0 view holds no CERT clique
+            # to count against (it never ran this check in the legacy
+            # split either — write admission there rested on the
+            # collective signature).  Commit still requires 2f+1 clique
+            # acks, every one of which DID enforce the writer's quorum
+            # certificate, and a pending record on the write plane
+            # carries no authority until certified.
+            if sig.cert:
+                try:
+                    for c in certmod.parse(sig.cert):
+                        if c.id == issuer.id:
+                            issuer = self._present(c)
+                            break
+                except Exception:
+                    pass
+            self._check_quorum_certificate(issuer)
+
+        rdata = None
+        try:
+            rdata = self.storage.read(variable, 0)
+        except ERR_NOT_FOUND:
+            pass
+
+        inherit = None
+        echo = False  # exact stored <t, value> re-ack
+        rp = pkt.parse(rdata) if rdata is not None else None
+        if rp is not None:
+            # TPA gate first, as in the sign phase: the client's auth
+            # proof rides the ss slot of the request.
+            if rp.auth is not None:
+                if proof is None:
+                    raise ERR_AUTHENTICATION_FAILURE
+                try:
+                    self.crypt.collective.verify(
+                        variable,
+                        proof,
+                        qm.choose_quorum_for(self.qs, variable, qm.AUTH),
+                        self.crypt.keyring,
+                        use_cache=False,
+                    )
+                except Exception:
+                    raise ERR_AUTHENTICATION_FAILURE from None
+            if t == rp.t and val == rp.value:
+                echo = True  # idempotent retry, write-once included
+            elif rp.t == MAX_UINT64:
+                raise ERR_NO_MORE_WRITE
+            elif t <= rp.t:
+                # Stale optimistic timestamp: decline with the hint.
+                metrics.incr("server.write_sign.decline")
+                return pkt.serialize_ws_ack(decline_t=rp.t)
+            if not echo:
+                # TOFU, from the write path (reference: server.go:329-
+                # 337) — against the latest CERTIFIED owner only.
+                prev_sig = self._tofu_prev_sig(variable, rp)
+                if prev_sig is not None:
+                    new_issuer = sigmod.issuer(sig, self.crypt.keyring)
+                    prev_issuer = sigmod.issuer(
+                        prev_sig, self.crypt.keyring
+                    )
+                    if (
+                        prev_issuer.id != new_issuer.id
+                        and prev_issuer.uid != new_issuer.uid
+                    ):
+                        raise ERR_PERMISSION_DENIED
+            inherit = rp.auth
+
+        share_bytes = b""
+        pending_data = None
+        if signs:
+            tbss = pkt.tbss(req)
+            share = self.crypt.collective.sign(self.crypt.signer, tbss)
+            share_bytes = pkt.serialize_signature(share)
+            pending_data = share.data
+
+        # Persist as commit-pending: partial ss (our own share when we
+        # hold a sign seat, an empty marker otherwise), completed=False.
+        # Never downgrade a certified record: an echo of a <t, value>
+        # the back-fill already completed keeps the completed bytes.
+        if not (echo and rp.ss is not None and rp.ss.completed):
+            pending = pkt.SignaturePacket(
+                type=pkt.SIGNATURE_TYPE_NATIVE,
+                version=1,
+                completed=False,
+                data=pending_data,
+            )
+            stored = pkt.serialize(variable, val, t, sig, pending, inherit)
+            self._persist(variable, t, stored)
+        metrics.incr("server.write_sign.ok")
+        return pkt.serialize_ws_ack(share=share_bytes)
+
+    def _tofu_prev_sig(self, variable: bytes, rp) -> pkt.SignaturePacket | None:
+        """The writer signature that currently OWNS ``variable`` for
+        the TOFU check: the latest CERTIFIED record's.  Commit-pending
+        and sign-phase residue never grants ownership — any
+        quorum-certificate-valid writer can plant residue, so
+        ownership-by-residue would let a failed racer (or a deliberate
+        squatter) lock the real owner out of its own variable.  None =
+        no certified ownership established yet (TOFU vacuous, exactly
+        like a fresh variable)."""
+        if rp.sig is not None and rp.ss is not None and rp.ss.completed:
+            return rp.sig
+        for v in self._versions_below(variable, rp.t):
+            try:
+                cp = pkt.parse(self.storage.read(variable, v))
+            except Exception:
+                continue
+            if cp.ss is not None and cp.ss.completed:
+                return cp.sig
+        return None
+
+    def _stale_version_upgrade(
+        self, variable: bytes, val, t: int, out: bytes
+    ) -> bytes | None:
+        """Admission for a write BELOW the latest stored version.
+
+        Allowed only as the in-place certification of a commit-pending
+        version this replica already admitted: the stored version at
+        ``t`` must exist with the SAME value.  Returns the bytes to
+        persist at version ``t``, or ``None`` for an idempotent no-op
+        (already certified, or superseded by a newer COMPLETED version
+        — upgrading under one would make this replica's completed
+        sequence go back in time, the §8 monotonicity invariant).
+        Anything else is the plain stale write it always was."""
+        try:
+            vt = self.storage.read(variable, t)
+        except ERR_NOT_FOUND:
+            raise ERR_BAD_TIMESTAMP from None
+        vp = pkt.parse(vt)
+        if vp.value != val:
+            raise ERR_BAD_TIMESTAMP
+        if vp.ss is not None and vp.ss.completed:
+            return None  # already certified at t
+        for v in sorted(self._versions_above(variable, t), reverse=True):
+            try:
+                cp = pkt.parse(self.storage.read(variable, v))
+            except ERR_NOT_FOUND:
+                continue
+            if cp.ss is not None and cp.ss.completed:
+                return None  # superseded: a newer certified version rules
+        metrics.incr("server.write.upgrade")
+        if vp.auth is not None:
+            p = pkt.parse(out)
+            return pkt.serialize(variable, val, t, p.sig, p.ss, vp.auth)
+        return out
+
+    def _versions_above(self, variable: bytes, t: int) -> list[int]:
+        versions = getattr(self.storage, "versions", None)
+        if versions is None:
+            return []
+        try:
+            return [v for v in versions(variable) if v > t]
+        except Exception:
+            return []
 
     def _revoke_signers(self, signers1: list[int], signers2: list[int]) -> bool:
         """Revoke every id present in both signer sets; broadcast the
@@ -947,7 +1228,9 @@ class Server(Protocol):
         for r in pkt.parse_list(req):
             try:
                 p = pkt.parse(r)
-                raw = self._read_item(p.variable or b"", p.ss)
+                raw = self._read_item(
+                    p.variable or b"", p.ss, certified_only=(p.t == 1)
+                )
                 results.append((None, raw or b""))
             except Exception as e:
                 results.append((_errstr(e), b""))
@@ -1209,7 +1492,8 @@ class Server(Protocol):
             except Exception as e:
                 results[i] = (_errstr(e), b"")
                 continue
-            self._persist(variable, t, out)
+            if out is not None:  # None = idempotent no-op (see checks)
+                self._persist(variable, t, out)
             metrics.incr("server.write.ok")
             results[i] = (None, b"")
 
@@ -1237,6 +1521,7 @@ class Server(Protocol):
         tp.BATCH_READ: "_batch_read",
         tp.SYNC_DIGEST: "_sync_digest",
         tp.SYNC_PULL: "_sync_pull",
+        tp.WRITE_SIGN: "_write_sign",
     }
 
 

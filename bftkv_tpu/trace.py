@@ -313,6 +313,14 @@ class Tracer:
         except Exception:  # a weird attr value must never kill a request
             pass
 
+    def cursor(self) -> int:
+        """The current export cursor (sequence of the newest recorded
+        span) without serializing anything — pass to :meth:`export` as
+        ``since`` to drain only what happens after this point (the
+        bench's per-round breakdown uses it to scope one section)."""
+        with self._lock:
+            return self._seq
+
     # -- export (the fleet collector's feed) ------------------------------
 
     def export(self, since: int = 0) -> dict:

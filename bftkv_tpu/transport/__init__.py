@@ -57,6 +57,7 @@ __all__ = [
     "BATCH_READ",
     "SYNC_DIGEST",
     "SYNC_PULL",
+    "WRITE_SIGN",
     "PREFIX",
     "COMMAND_NAMES",
     "MulticastResponse",
@@ -100,6 +101,14 @@ BATCH_READ = 16
 # a Byzantine peer no authority (bftkv_tpu/sync).
 SYNC_DIGEST = 17
 SYNC_PULL = 18
+# Round-collapsed write (no reference analog — the reference pays a
+# separate sign round before every write): ONE fan-out carries the
+# writer-signed record; quorum members run the full sign-path checks,
+# persist the record as commit-pending, and piggyback their
+# collective-signature share inside the ack (packet.serialize_ws_ack).
+# Old servers answer ERR_UNKNOWN_COMMAND and the client falls back to
+# the classic time → sign → write rounds for that quorum.
+WRITE_SIGN = 19
 
 PREFIX = "/bftkv/v1/"
 
@@ -123,6 +132,7 @@ COMMAND_NAMES = {
     BATCH_READ: "batch_read",
     SYNC_DIGEST: "sync_digest",
     SYNC_PULL: "sync_pull",
+    WRITE_SIGN: "write_sign",
 }
 COMMANDS_BY_NAME = {v: k for k, v in COMMAND_NAMES.items()}
 
@@ -736,6 +746,10 @@ def _post_one(tr, name, peer, addr, cipher, nonce, payload, ch) -> None:
             if sec is None:
                 raise
             sec.message.invalidate(peer.id)
+            # Re-seal for THIS peer alone: the rest of the group keeps
+            # its warm session envelopes (a restarted peer must not
+            # degrade the whole fan-out back to bootstrap sealing).
+            metrics.incr("crypto.session.reseal", labels={"cmd": name})
             nonce2 = tr.generate_random()
             cipher2 = sec.message.encrypt(
                 [peer], payload, nonce2, force_bootstrap=True

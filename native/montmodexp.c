@@ -1,0 +1,202 @@
+/* Montgomery modular exponentiation for the RSA hot path.
+ *
+ * CPython's big-int pow() is the write path's floor: one RSA-2048
+ * CRT sign is two 1024-bit modexps at ~4 ms each, it holds the GIL
+ * for the duration, and a 4-signs-per-write protocol tops out around
+ * 25 writes/s/core no matter how few round trips the transport pays
+ * (docs/PERFORMANCE.md "RSA floor").  This extension implements the
+ * same modexp as fixed-width CIOS Montgomery multiplication with a
+ * 4-bit window, releases the GIL while computing, and is loaded
+ * opportunistically by bftkv_tpu/crypto/rsa.py (BFTKV_NATIVE_MODEXP=off
+ * disables; the pure pow() path remains the semantics oracle, pinned
+ * by differential tests in tests/test_rsa.py).
+ *
+ * API:  powmod(base, exp, mod, r2, n0inv) -> bytes
+ *   base, mod, r2: big-endian byte strings, len(mod) a multiple of 8;
+ *   base < mod;  r2 = 2^(2*64*nlimbs) mod mod (caller precomputes,
+ *   cached per key);  n0inv = -mod^-1 mod 2^64.
+ *   exp: big-endian byte string, any length > 0.
+ * Returns the big-endian result, len(mod) bytes.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+typedef unsigned __int128 u128;
+typedef uint64_t u64;
+
+#define MAX_LIMBS 64 /* up to 4096-bit moduli */
+
+/* little-endian limb arrays throughout */
+
+static void be_to_limbs(const unsigned char *be, Py_ssize_t len, u64 *out,
+                        int nlimbs) {
+    memset(out, 0, (size_t)nlimbs * 8);
+    for (Py_ssize_t i = 0; i < len; i++) {
+        Py_ssize_t bit = (len - 1 - i);
+        out[bit / 8] |= (u64)be[i] << (8 * (bit % 8));
+    }
+}
+
+static void limbs_to_be(const u64 *in, int nlimbs, unsigned char *be) {
+    for (int i = 0; i < nlimbs; i++) {
+        u64 w = in[nlimbs - 1 - i];
+        for (int b = 0; b < 8; b++)
+            be[i * 8 + b] = (unsigned char)(w >> (8 * (7 - b)));
+    }
+}
+
+static int geq(const u64 *a, const u64 *n, int L) {
+    for (int i = L - 1; i >= 0; i--) {
+        if (a[i] > n[i]) return 1;
+        if (a[i] < n[i]) return 0;
+    }
+    return 1; /* equal */
+}
+
+static void sub_n(u64 *a, const u64 *n, int L) {
+    u64 borrow = 0;
+    for (int i = 0; i < L; i++) {
+        u64 ni = n[i] + borrow;
+        borrow = (ni < borrow) | (a[i] < ni);
+        a[i] -= ni;
+    }
+}
+
+/* CIOS Montgomery multiplication: t = a*b*R^-1 mod n (R = 2^(64L)).
+ * Accumulator has L+2 limbs; result reduced to < n. */
+static void mont_mul(const u64 *a, const u64 *b, const u64 *n, u64 n0inv,
+                     int L, u64 *t /* L+2 scratch, output in t[0..L-1] */) {
+    memset(t, 0, (size_t)(L + 2) * 8);
+    for (int i = 0; i < L; i++) {
+        u64 carry = 0;
+        u64 ai = a[i];
+        for (int j = 0; j < L; j++) {
+            u128 s = (u128)ai * b[j] + t[j] + carry;
+            t[j] = (u64)s;
+            carry = (u64)(s >> 64);
+        }
+        u128 s = (u128)t[L] + carry;
+        t[L] = (u64)s;
+        t[L + 1] = (u64)(s >> 64);
+
+        u64 m = t[0] * n0inv;
+        s = (u128)m * n[0] + t[0];
+        carry = (u64)(s >> 64);
+        for (int j = 1; j < L; j++) {
+            s = (u128)m * n[j] + t[j] + carry;
+            t[j - 1] = (u64)s;
+            carry = (u64)(s >> 64);
+        }
+        s = (u128)t[L] + carry;
+        t[L - 1] = (u64)s;
+        t[L] = t[L + 1] + (u64)(s >> 64);
+        t[L + 1] = 0;
+    }
+    if (t[L] || geq(t, n, L)) sub_n(t, n, L);
+}
+
+static PyObject *py_powmod(PyObject *self, PyObject *args) {
+    Py_buffer base_b, exp_b, mod_b, r2_b;
+    unsigned long long n0inv;
+    if (!PyArg_ParseTuple(args, "y*y*y*y*K", &base_b, &exp_b, &mod_b,
+                          &r2_b, &n0inv))
+        return NULL;
+
+    PyObject *ret = NULL;
+    int L = (int)(mod_b.len / 8);
+    if (mod_b.len % 8 != 0 || L <= 0 || L > MAX_LIMBS ||
+        base_b.len > mod_b.len || r2_b.len > mod_b.len || exp_b.len == 0) {
+        PyErr_SetString(PyExc_ValueError, "montmodexp: bad operand shape");
+        goto done;
+    }
+
+    {
+        u64 n[MAX_LIMBS], x[MAX_LIMBS], r2[MAX_LIMBS];
+        u64 table[16][MAX_LIMBS];
+        u64 acc[MAX_LIMBS], t[MAX_LIMBS + 2];
+        unsigned char out[MAX_LIMBS * 8];
+        const unsigned char *e = (const unsigned char *)exp_b.buf;
+        Py_ssize_t elen = exp_b.len;
+
+        be_to_limbs((const unsigned char *)mod_b.buf, mod_b.len, n, L);
+        be_to_limbs((const unsigned char *)base_b.buf, base_b.len, x, L);
+        be_to_limbs((const unsigned char *)r2_b.buf, r2_b.len, r2, L);
+        if (!(n[0] & 1)) {
+            PyErr_SetString(PyExc_ValueError, "montmodexp: even modulus");
+            goto done;
+        }
+
+        Py_BEGIN_ALLOW_THREADS;
+
+        /* table[1] = x in Montgomery form; table[0] = 1 in Mont form */
+        mont_mul(x, r2, n, (u64)n0inv, L, t);
+        memcpy(table[1], t, (size_t)L * 8);
+        {
+            u64 one[MAX_LIMBS];
+            memset(one, 0, (size_t)L * 8);
+            one[0] = 1;
+            mont_mul(one, r2, n, (u64)n0inv, L, t);
+            memcpy(table[0], t, (size_t)L * 8);
+        }
+        for (int i = 2; i < 16; i++) {
+            mont_mul(table[i - 1], table[1], n, (u64)n0inv, L, t);
+            memcpy(table[i], t, (size_t)L * 8);
+        }
+
+        /* 4-bit windowed scan over the big-endian exponent bytes */
+        memcpy(acc, table[0], (size_t)L * 8);
+        for (Py_ssize_t i = 0; i < elen; i++) {
+            unsigned char byte = e[i];
+            for (int half = 0; half < 2; half++) {
+                int w = half == 0 ? (byte >> 4) : (byte & 0xF);
+                for (int s = 0; s < 4; s++) {
+                    mont_mul(acc, acc, n, (u64)n0inv, L, t);
+                    memcpy(acc, t, (size_t)L * 8);
+                }
+                if (w) {
+                    mont_mul(acc, table[w], n, (u64)n0inv, L, t);
+                    memcpy(acc, t, (size_t)L * 8);
+                }
+            }
+        }
+
+        /* out of Montgomery form */
+        {
+            u64 one[MAX_LIMBS];
+            memset(one, 0, (size_t)L * 8);
+            one[0] = 1;
+            mont_mul(acc, one, n, (u64)n0inv, L, t);
+            memcpy(acc, t, (size_t)L * 8);
+        }
+
+        limbs_to_be(acc, L, out);
+
+        Py_END_ALLOW_THREADS;
+
+        ret = PyBytes_FromStringAndSize((const char *)out, (Py_ssize_t)L * 8);
+    }
+
+done:
+    PyBuffer_Release(&base_b);
+    PyBuffer_Release(&exp_b);
+    PyBuffer_Release(&mod_b);
+    PyBuffer_Release(&r2_b);
+    return ret;
+}
+
+static PyMethodDef Methods[] = {
+    {"powmod", py_powmod, METH_VARARGS,
+     "powmod(base, exp, mod, r2, n0inv) -> bytes (all big-endian; "
+     "len(mod) %% 8 == 0; r2 = 2^(2*64*L) mod mod; n0inv = -mod^-1 mod 2^64)"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_montmodexp",
+    "fixed-width Montgomery modexp (GIL-releasing)", -1, Methods,
+};
+
+PyMODINIT_FUNC PyInit__montmodexp(void) { return PyModule_Create(&moduledef); }

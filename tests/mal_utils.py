@@ -63,6 +63,13 @@ class MalServer(Server):
         # store without any verification (reference: malWrite, :91-112)
         return byz.store_unverified(self, tp.WRITE, req, peer, sender)
 
+    def _write_sign(self, req: bytes, peer, sender):
+        if not self._is_mal:
+            return super()._write_sign(req, peer, sender)
+        # the collapsed round faces the same adversary: sign + store
+        # anything, ack with a genuine share
+        return byz.write_sign_anything(self, tp.WRITE_SIGN, req, peer, sender)
+
     # The batch pipeline must face the same adversary: a colluder signs
     # and stores every item of a batch without any verification.
 
@@ -70,6 +77,11 @@ class MalServer(Server):
         if not self._is_mal:
             return super()._batch_sign(req, peer, sender)
         return byz.batch_sign_anything(self, tp.BATCH_SIGN, req, peer, sender)
+
+    def _batch_time(self, req: bytes, peer, sender):
+        if not self._is_mal:
+            return super()._batch_time(req, peer, sender)
+        return byz.batch_time_skew(self, tp.BATCH_TIME, req, peer, sender)
 
     def _batch_write(self, req: bytes, peer, sender):
         if not self._is_mal:

@@ -147,6 +147,7 @@ def test_read_many_repairs_stale_replica(cluster):
 
     c = cluster.clients[0]
     c.write(b"rm/heal", b"healthy")
+    c.drain_tails()  # the collective back-fill rides the async tail
     victim = cluster.storage_servers[0]
     stored = victim.storage.read(b"rm/heal", 0)
     p = pkt.parse(stored)

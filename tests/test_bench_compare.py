@@ -28,17 +28,42 @@ def detail_record(sections):
 def test_extracts_both_formats():
     d = extract_sections(driver_record({"cluster_4": ["cpu", 7.5],
                                         "rns_kernel": "skip"}))
-    assert d["cluster_4"] == ("cpu", 7.5)
-    assert d["rns_kernel"] == ("skip", None)
+    assert d["cluster_4"] == ("cpu", 7.5, None)
+    assert d["rns_kernel"] == ("skip", None, None)
     d = extract_sections(detail_record({
-        "cluster_4": {"backend": "cpu", "writes_per_sec": 18.6},
+        "cluster_4": {"backend": "cpu", "writes_per_sec": 18.6,
+                      "write_p50_s": 0.42},
         "cluster_shards": {"backend": "cpu", "writes_per_sec": 55.0},
         "kernel": {"backend": "tpu", "rsa2048_verifies_per_sec": 5e5},
         "bad": {"error": "boom"},
     }))
-    assert d["cluster_4"] == ("cpu", 18.6)
+    assert d["cluster_4"] == ("cpu", 18.6, 0.42)
+    assert d["cluster_shards"] == ("cpu", 55.0, None)
     assert d["kernel"][1] == 5e5
-    assert d["bad"] == ("err", None)
+    assert d["bad"] == ("err", None, None)
+    # three-element compact form (driver records after the round collapse)
+    d = extract_sections(driver_record({"cluster_4": ["cpu", 7.5, 0.3]}))
+    assert d["cluster_4"] == ("cpu", 7.5, 0.3)
+
+
+def test_p50_latency_regression_gated():
+    old = driver_record({"cluster_4": ["cpu", 10.0, 0.40]})
+    new = driver_record({"cluster_4": ["cpu", 10.5, 0.60]})  # p50 +50%
+    lines, regressions, compared = compare(old, new)
+    assert regressions == ["cluster_4 (write p50)"]
+    assert any("p50" in ln for ln in lines)
+
+
+def test_p50_improvement_and_missing_side_pass():
+    # faster p50 is never a regression
+    old = driver_record({"cluster_4": ["cpu", 10.0, 0.85]})
+    new = driver_record({"cluster_4": ["cpu", 10.0, 0.30]})
+    _lines, regressions, _ = compare(old, new)
+    assert regressions == []
+    # a record from before the metric existed must not fail every diff
+    old2 = driver_record({"cluster_4": ["cpu", 10.0]})
+    _lines, regressions, _ = compare(old2, new)
+    assert regressions == []
 
 
 def test_improvement_and_within_threshold_pass():

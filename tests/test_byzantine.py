@@ -155,6 +155,7 @@ def test_lone_signed_newest_value_wins_over_stale_threshold(mal_cluster):
     honest = c.clients[1]
     honest.write(b"ur_var", b"old")
     honest.write(b"ur_var", b"newest")
+    honest.drain_tails()  # the scenario needs the CERTIFIED newest record
 
     # Simulate under-replication of the newest write: every READ-quorum
     # replica except one is rolled back to the old committed state.
@@ -214,6 +215,7 @@ def test_same_uid_may_overwrite(mal_cluster):
     uni = c.universe
     owner = c.clients[1]
     owner.write(b"tofu_uid_var", b"original")
+    owner.drain_tails()  # certified ownership before the alias overwrite
 
     # a fresh identity with the same uid, counter-signed by the quorum
     u2 = uni.users[1]

@@ -104,6 +104,10 @@ def test_byzantine_programs_checker_clean(cluster):
         # committed value.
         cl.write(b"chaos/fresh", b"old")
         cl.write(b"chaos/fresh", b"new")
+        # Both wave-1 write-plane members are the two faulty nodes here
+        # (beyond the f=1 budget reads are promised under) — settle the
+        # back-fill so the honest plane holds the certified record.
+        cl.drain_tails()
         cluster.recorder.write_ok("u01", b"chaos/fresh", b"new")
         got = cl.read(b"chaos/fresh")
         cluster.recorder.read_ok("u01", b"chaos/fresh", got)

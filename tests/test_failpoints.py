@@ -187,7 +187,13 @@ def test_drop_beyond_f_fails_write_cleanly(cluster):
     fp.registry.add(
         "transport.send",
         "drop",
-        match={"dst": lambda d: d in ("rw02", "rw03", "rw04"), "cmd": "write"},
+        # Both write-plane commands: the collapsed round (write_sign)
+        # carries the commit, the classic round (write) the fallback
+        # and back-fill.
+        match={
+            "dst": lambda d: d in ("rw02", "rw03", "rw04"),
+            "cmd": lambda c: c in ("write", "write_sign"),
+        },
         rule_id="d2",
     )
     with pytest.raises(Error):
@@ -205,7 +211,7 @@ def test_retry_recovers_transient_drop(cluster):
     fp.registry.add(
         "transport.send",
         "drop",
-        match={"dst": "rw01", "cmd": "time"},
+        match={"dst": "rw01", "cmd": "write_sign"},
         times=2,
         rule_id="r",
     )
@@ -217,7 +223,7 @@ def test_retry_recovers_transient_drop(cluster):
         del cl.tr.retry_policy
     assert cl.read(b"fp_retry") == b"retried"
     snap = metrics.snapshot()
-    key = "transport.retries{cmd=time}"
+    key = "transport.retries{cmd=write_sign}"
     assert snap.get(key, 0) >= before.get(key, 0) + 2
 
 
@@ -259,6 +265,10 @@ def test_circuit_breaker_skips_dead_peer_and_recovers(cluster):
     try:
         for i in range(3):
             cl.write(b"fp_cb_%d" % i, b"v")  # 3-of-4 carries each write
+            # rw04 sits outside wave 1; each back-fill flush is what
+            # posts to it — drain per write so the failures are
+            # consecutive, not coalesced into one batch.
+            cl.drain_tails()
         assert "loop://rw04" in health.open_peers()
         snap = metrics.snapshot()
         skipped = sum(
@@ -274,9 +284,11 @@ def test_circuit_breaker_skips_dead_peer_and_recovers(cluster):
         victim.start()  # peer returns; wait past open_secs, then probe
         time.sleep(0.25)
         cl.write(b"fp_cb_back", b"v")
+        cl.drain_tails()  # the back-fill flush carries the probe
         deadline = time.monotonic() + 5
         while health.open_peers() and time.monotonic() < deadline:
             cl.write(b"fp_cb_back", b"v")
+            cl.drain_tails()
             time.sleep(0.05)
         assert "loop://rw04" not in health.open_peers()
         assert metrics.snapshot().get(
@@ -327,6 +339,9 @@ def test_colluder_program_equivalent_to_malserver(cluster):
     rules = byz.make_colluder(fp.registry, "rw01")
     try:
         cl.write(b"fp_byz", b"honest")
+        # rw01 is wave-1 AND a colluder: the honest plane copies ride
+        # the back-fill; settle it before reading.
+        cl.drain_tails()
         assert cl.read(b"fp_byz") == b"honest"
     finally:
         fp.registry.remove_all(rules)

@@ -100,6 +100,7 @@ def test_daemon_reads_have_quorum_with_local_trust(tmp_path):
         g, crypt, qs = topology.load_home(str(tmp_path / u.name))
         cl = Client(g, qs, TrLoopback(crypt, net), crypt)
         cl.write(b"lt/d", b"daemon-visible")
+        cl.drain_tails()  # certified copies before the daemon-side read
         # …and the a01 daemon's own client (its graph carries the
         # localtrust edges) can read it back.
         g1, c1, q1 = triples["a01"]

@@ -1,0 +1,335 @@
+"""Round-collapsed writes (PR 8): piggybacked shares, presession
+leases, 2f+1 early commit with the async certify tail.
+
+The acceptance smoke lives here too: a steady-state write crosses the
+network in at most TWO quorum round trips — the combined WRITE_SIGN
+fan-out the caller waits on, plus the async collective back-fill —
+counted from the client-side ``transport.rpcs`` deltas."""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from bftkv_tpu import packet as pkt
+from bftkv_tpu import quorum as qm
+from bftkv_tpu import transport as tp
+from bftkv_tpu.errors import Error, ERR_UNKNOWN_COMMAND
+from bftkv_tpu.faults import failpoint as fp
+from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu.protocol.server import Server
+
+from cluster_utils import start_cluster
+
+BITS = 1024
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = start_cluster(4, 1, 4, bits=BITS)
+    yield c
+    c.stop()
+
+
+def _client_rpcs(snap: dict) -> dict[str, int]:
+    """client-side transport.rpcs by command name."""
+    out: dict[str, int] = {}
+    for k, v in snap.items():
+        if k.startswith("transport.rpcs{") and "side=client" in k:
+            cmd = k.split("cmd=")[1].split(",")[0].rstrip("}")
+            out[cmd] = out.get(cmd, 0) + v
+    return out
+
+
+def _delta(after: dict, before: dict) -> dict[str, int]:
+    return {
+        k: v - before.get(k, 0)
+        for k, v in after.items()
+        if v - before.get(k, 0) > 0
+    }
+
+
+# -- the acceptance smoke: <= 2 quorum round trips per steady write ---------
+
+
+def test_steady_state_write_is_two_round_trips(cluster):
+    """After warmup, one write = one WRITE_SIGN fan-out (the round the
+    caller waits on) + one batched BATCH_WRITE back-fill round on the
+    async tail — and NOTHING else: no TIME round, no SIGN round."""
+    cl = cluster.clients[0]
+    cl.write(b"rt/warm", b"v")  # sessions + quorum caches + pump
+    cl.drain_tails()
+
+    before = _client_rpcs(metrics.snapshot())
+    cl.write(b"rt/steady", b"value")
+    cl.drain_tails()
+    after = _client_rpcs(metrics.snapshot())
+    d = _delta(after, before)
+
+    # Only the two write-path rounds crossed the network.
+    assert set(d) <= {"write_sign", "batch_write"}, d
+    assert d.get("write_sign", 0) >= 1
+
+    # Round-trip bound: the combined round fans to (at most) the
+    # sign q ∪ write q union, the back-fill to the write quorum — two
+    # rounds' worth of RPCs.
+    qa = qm.choose_quorum_for(cl.qs, b"rt/steady", qm.AUTH | qm.PEER)
+    qw = qm.choose_quorum_for(cl.qs, b"rt/steady", qm.WRITE)
+    union = {n.id for n in qa.nodes()} | {n.id for n in qw.nodes()}
+    assert d.get("write_sign", 0) <= len(union)
+    assert d.get("batch_write", 0) <= len(qw.nodes())
+    assert sum(d.values()) <= len(union) + len(qw.nodes())
+
+
+def test_repeat_writer_uses_lease_no_declines(cluster):
+    """Overwriting a variable this client already wrote costs zero
+    timestamp declines: the presession lease supplies the guess."""
+    cl = cluster.clients[0]
+    cl.write(b"lease/x", b"v1")
+    before = metrics.snapshot().get("client.piggyback.retry_t", 0)
+    cl.write(b"lease/x", b"v2")
+    cl.write(b"lease/x", b"v3")
+    assert metrics.snapshot().get("client.piggyback.retry_t", 0) == before
+    assert cl.read(b"lease/x") == b"v3"
+
+
+def test_stale_lease_declines_and_retries_in_round(cluster):
+    """A cold lease guesses t=1 against a variable that moved on; the
+    quorum answers with stored-timestamp hints and the SAME round
+    structure retries — no TIME round, no revocation of the honest
+    writer."""
+    cl = cluster.clients[0]
+    cl.write(b"stale/x", b"v1")
+    cl.write(b"stale/x", b"v2")
+    cl._presession.lease_drop(b"stale/x")  # simulate a restarted client
+    before = metrics.snapshot()
+    cl.write(b"stale/x", b"v3")
+    snap = metrics.snapshot()
+    assert snap.get("client.piggyback.retry_t", 0) > before.get(
+        "client.piggyback.retry_t", 0
+    )
+    # the decline path must not have touched the TIME round
+    assert _delta(_client_rpcs(snap), _client_rpcs(before)).get(
+        "time", 0
+    ) == 0
+    assert cl.read(b"stale/x") == b"v3"
+    # an optimistic decline is not equivocation: nobody got revoked
+    assert not cl.self_node.revoked
+
+
+def test_tail_certifies_the_record(cluster):
+    """After the tail drains, the write plane holds the record with a
+    completed, sufficient collective signature (the wotqs math is
+    untouched: suff signers, verified)."""
+    cl = cluster.clients[0]
+    cl.write(b"cert/x", b"certified")
+    cl.drain_tails()
+    qa = qm.choose_quorum_for(cl.qs, b"cert/x", qm.AUTH)
+    certified = 0
+    for srv in cluster.storage_servers:
+        raw = srv.storage.read(b"cert/x", 0)
+        p = pkt.parse(raw)
+        if p.ss is not None and p.ss.completed:
+            srv.crypt.collective.verify(
+                pkt.tbss(raw), p.ss, qa, srv.crypt.keyring
+            )
+            certified += 1
+    assert certified == len(cluster.storage_servers)
+
+
+def test_read_before_backfill_resolves_committed_value(cluster):
+    """The race the early commit opens: a read lands after the 2f+1
+    commit but before the collective back-fill.  The pending record is
+    served, wins by responder threshold, and the READER completes the
+    certification — the committed value comes back, never a bare
+    unbacked one."""
+    cl = cluster.clients[0]
+    fp.arm(81)
+    try:
+        # Cut the back-fill entirely: both delivery shapes drop (the
+        # coalescer's BATCH_WRITE and the certify-repair WRITE).
+        fp.registry.add(
+            "transport.send",
+            "drop",
+            match={"cmd": lambda c: c in ("write", "batch_write")},
+            rule_id="bf",
+        )
+        before = metrics.snapshot().get("client.read.certified", 0)
+        cl.write(b"race/x", b"committed")
+        cl.drain_tails()
+        # Every write-plane copy that exists is still commit-pending,
+        # and at least the commit threshold (f+1) of them exist — the
+        # wave-1 fan-out wrote those; the rest would have come from the
+        # (cut) back-fill.
+        pending = 0
+        for srv in cluster.storage_servers:
+            try:
+                raw = srv.storage.read(b"race/x", 0)
+            except Exception:
+                continue
+            p = pkt.parse(raw)
+            assert p.ss is not None and not p.ss.completed
+            pending += 1
+        assert pending >= 2  # f+1 for the 4-node write plane
+        assert cl.read(b"race/x") == b"committed"
+        assert metrics.snapshot().get("client.read.certified", 0) > before
+    finally:
+        fp.disarm()
+    # With the drop healed, the next read re-certifies and its repair
+    # tail upgrades the pending copies to the certified record.
+    assert cl.read(b"race/x") == b"committed"
+    cl.drain_tails()
+    deadline = time.time() + 5
+    done = 0
+    while time.time() < deadline:
+        done = sum(
+            1
+            for srv in cluster.storage_servers
+            if (p := pkt.parse(srv.storage.read(b"race/x", 0))).ss
+            is not None
+            and p.ss.completed
+        )
+        if done:
+            break
+        time.sleep(0.05)
+    assert done >= 1
+
+
+def test_batched_read_resolves_pending_too(cluster):
+    """read_many hits the same pending-resolution path."""
+    cl = cluster.clients[0]
+    fp.arm(82)
+    try:
+        fp.registry.add(
+            "transport.send",
+            "drop",
+            match={"cmd": lambda c: c in ("write", "batch_write")},
+            rule_id="bf2",
+        )
+        cl.write(b"race/m1", b"mv1")
+        cl.write(b"race/m2", b"mv2")
+        cl.drain_tails()
+        assert cl.read_many([b"race/m1", b"race/m2"]) == [b"mv1", b"mv2"]
+    finally:
+        fp.disarm()
+
+
+# -- starved tails surface in the health plane ------------------------------
+
+
+def test_starved_tail_raises_anomaly():
+    """n=5 clique: commit lands at 2f+1 = 3 acks but suff = 4.  Two
+    share-withholding clique members (Byzantine-lite: honest persist,
+    shareless ack — clean drops beyond f would fail the round outright,
+    so starvation is inherently a misbehavior phenomenon) starve the
+    tail.  The write still succeeds (that is the point of early
+    commit), the counter fires, the fleet collector turns it into an
+    anomaly, and a later read certifies the record anyway (helping)."""
+    from bftkv_tpu.obs import FleetCollector, LocalSource
+
+    c = start_cluster(5, 1, 4, bits=BITS)
+    cl = c.clients[0]
+
+    def shareless(server, cmd, req, peer, sender):
+        server._write_sign(req, peer, sender)  # honest admission+persist
+        return pkt.serialize_ws_ack(share=b"")  # ... but no share
+
+    try:
+        cl.write(b"starve/warm", b"v")
+        cl.drain_tails()
+        collector = FleetCollector(
+            [
+                LocalSource("a01", lambda: c.servers[0]),
+            ],
+            local_metrics=metrics,
+        )
+        collector.scrape_once()  # baseline for counter deltas
+        fp.arm(83)
+        try:
+            fp.registry.add(
+                "server.admission",
+                "handle",
+                match={
+                    "node": lambda n: n in ("a04", "a05"),
+                    "cmd": "write_sign",
+                },
+                fn=shareless,
+                rule_id="withhold2",
+            )
+            before = metrics.snapshot().get("client.tail.starved", 0)
+            cl.write(b"starve/x", b"survives")
+            cl.drain_tails()
+            assert (
+                metrics.snapshot().get("client.tail.starved", 0)
+                == before + 1
+            )
+        finally:
+            fp.disarm()
+        collector.scrape_once()
+        kinds = {a["kind"] for a in collector.anomalies()}
+        assert "tail_starved" in kinds
+        # the read certifies the starved record (misbehavior healed)
+        assert cl.read(b"starve/x") == b"survives"
+    finally:
+        c.stop()
+
+
+# -- negotiation: old servers keep working ----------------------------------
+
+
+class LegacyServer(Server):
+    """A pre-piggyback server: WRITE_SIGN is an unknown command."""
+
+    _handlers = {
+        k: v for k, v in Server._handlers.items() if k != tp.WRITE_SIGN
+    }
+
+
+def test_legacy_quorum_falls_back_to_classic_rounds():
+    c = start_cluster(4, 1, 4, bits=BITS, server_cls=LegacyServer)
+    cl = c.clients[0]
+    try:
+        before = metrics.snapshot().get("client.piggyback.fallback", 0)
+        cl.write(b"legacy/x", b"old school")
+        assert cl.read(b"legacy/x") == b"old school"
+        snap = metrics.snapshot()
+        assert snap.get("client.piggyback.fallback", 0) > before
+        assert cl._legacy_peers  # the quorum is remembered as legacy
+        # subsequent writes skip the probe entirely
+        rpcs_before = _client_rpcs(metrics.snapshot())
+        cl.write(b"legacy/y", b"still old school")
+        d = _delta(_client_rpcs(metrics.snapshot()), rpcs_before)
+        assert d.get("write_sign", 0) == 0
+        assert cl.read(b"legacy/y") == b"still old school"
+    finally:
+        c.stop()
+
+
+def test_piggyback_off_env_uses_classic_rounds(monkeypatch):
+    from bftkv_tpu.protocol import client as client_mod
+
+    monkeypatch.setattr(client_mod, "_PIGGYBACK", False)
+    c = start_cluster(4, 1, 4, bits=BITS)
+    cl = c.clients[0]
+    try:
+        before = _client_rpcs(metrics.snapshot())
+        cl.write(b"off/x", b"classic")
+        d = _delta(_client_rpcs(metrics.snapshot()), before)
+        assert d.get("write_sign", 0) == 0
+        assert d.get("time", 0) >= 1 and d.get("sign", 0) >= 1
+        assert cl.read(b"off/x") == b"classic"
+    finally:
+        c.stop()
+
+
+def test_ws_ack_codec_roundtrip():
+    s, share, t = pkt.parse_ws_ack(pkt.serialize_ws_ack(share=b"abc"))
+    assert (s, share, t) == (pkt.WS_ACCEPT, b"abc", 0)
+    s, share, t = pkt.parse_ws_ack(pkt.serialize_ws_ack(decline_t=42))
+    assert (s, share, t) == (pkt.WS_DECLINE_T, b"", 42)
+    s, share, t = pkt.parse_ws_ack(pkt.serialize_ws_ack())
+    assert (s, share, t) == (pkt.WS_ACCEPT, b"", 0)
+    for bad in (b"", b"\x01", b"\x01short", b"\x02xxxxxxxxx"):
+        with pytest.raises(Error):
+            pkt.parse_ws_ack(bad)

@@ -1,0 +1,178 @@
+"""Presession pump: timestamp leases, warm-session resealing, and the
+stale-session edge in grouped envelope sealing (a restarted peer costs
+ONE per-peer reseal, never a whole-group OAEP bootstrap)."""
+
+from __future__ import annotations
+
+import pytest
+
+from bftkv_tpu.crypto.presession import MAX_UINT64, Presession
+from bftkv_tpu.faults.harness import build_cluster
+from bftkv_tpu.metrics import registry as metrics
+
+from cluster_utils import start_cluster
+
+BITS = 1024
+
+
+class _FakeClient:
+    tr = None
+
+
+# -- leases -----------------------------------------------------------------
+
+
+def test_lease_lifecycle():
+    p = Presession(_FakeClient())
+    assert p.next_t(b"x") == 1  # never seen: optimistic first write
+    p.lease_update(b"x", 4)
+    assert p.next_t(b"x") == 5
+    p.lease_update(b"x", 2)  # leases only move forward
+    assert p.next_t(b"x") == 5
+    p.lease_drop(b"x")
+    assert p.next_t(b"x") == 1
+
+
+def test_lease_never_aliases_write_once_marker():
+    p = Presession(_FakeClient())
+    p.lease_update(b"sealed", MAX_UINT64)
+    # guessing MAX_UINT64 would BE a write-once; the quorum answers
+    # ERR_NO_MORE_WRITE to t=1, which is the correct outcome
+    assert p.next_t(b"sealed") == 1
+
+
+def test_lease_lru_bound():
+    p = Presession(_FakeClient())
+    p.LEASE_MAX = 4
+    for i in range(8):
+        p.lease_update(b"k%d" % i, i + 1)
+    assert len(p._leases) == 4
+    assert p.next_t(b"k7") == 9  # newest kept
+    assert p.next_t(b"k0") == 1  # oldest evicted
+
+
+def test_presession_off_disables_leases(monkeypatch):
+    monkeypatch.setenv("BFTKV_PRESESSION", "off")
+    p = Presession(_FakeClient())
+    p.lease_update(b"x", 9)
+    assert p.next_t(b"x") == 1
+
+
+# -- signer maps (share-combination state) ----------------------------------
+
+
+def test_signer_map_memoized_per_quorum_object():
+    class _N:
+        def __init__(self, i):
+            self.id = i
+
+    class _Q:
+        def __init__(self):
+            self.calls = 0
+            self._nodes = [_N(1), _N(2)]
+
+        def nodes(self):
+            self.calls += 1
+            return self._nodes
+
+    q = _Q()
+    p = Presession(_FakeClient())
+    m1 = p.signer_map(q)
+    m2 = p.signer_map(q)
+    assert m1 is m2 and set(m1) == {1, 2}
+    assert q.calls == 1
+
+
+# -- session warming --------------------------------------------------------
+
+
+def test_pump_reseals_cold_peer():
+    c = start_cluster(4, 1, 4, bits=BITS)
+    cl = c.clients[0]
+    try:
+        cl.write(b"warm/x", b"v")  # establishes sessions + warm set
+        cl.drain_tails()
+        msg = cl.tr.security.message
+        victim = next(iter(cl._presession._warm_peers.values()))
+        msg.invalidate(victim.id)
+        assert not msg.has_session(victim.id)
+        before = metrics.snapshot().get(
+            "crypto.session.reseal{cmd=presession}", 0
+        )
+        resealed = cl._presession.warm_once()
+        # The invalidated victim, plus any quorum member the staged
+        # wave never had to contact — warming those is the pump's job.
+        assert resealed >= 1
+        assert msg.has_session(victim.id)
+        assert (
+            metrics.snapshot().get(
+                "crypto.session.reseal{cmd=presession}", 0
+            )
+            == before + resealed
+        )
+        # nothing cold: the next round is a no-op
+        assert cl._presession.warm_once() == 0
+    finally:
+        c.stop()
+
+
+def test_restarted_peer_costs_one_reseal_not_group_bootstrap():
+    """The stale-session edge: a replica restart invalidates only ITS
+    pairwise session.  The next write's grouped sealing keeps every
+    other peer on the session envelope — the per-recipient OAEP
+    bootstrap wrap count grows by ~the single resealed peer, not by the
+    whole group — and the transport's unknown-session retry heals the
+    one stale link (crypto.session.reseal)."""
+    c = build_cluster(4, 1, 4, bits=BITS)
+    cl = c.clients[0]
+    try:
+        cl.write(b"reseal/x", b"v1")
+        cl.drain_tails()
+        cl.write(b"reseal/y", b"v2")  # steady state: all sessions warm
+        cl.drain_tails()
+
+        snap0 = metrics.snapshot()
+        c.restart("rw01")  # fresh Server + MessageSecurity on the same data
+        cl.write(b"reseal/z", b"v3")
+        cl.drain_tails()
+        snap1 = metrics.snapshot()
+
+        reseals = sum(
+            snap1.get(k, 0) - snap0.get(k, 0)
+            for k in snap1
+            if k.startswith("crypto.session.reseal")
+        )
+        assert reseals >= 1
+        # The client's own sealing stayed warm for everyone else: its
+        # share of fresh bootstrap wraps is the restarted peer's reseal
+        # (the restarted SERVER also bootstraps its response sessions —
+        # one per peer it answers — so bound the total instead of
+        # demanding zero).
+        wraps = snap1.get(
+            "crypto.session.bootstrap_wraps", 0
+        ) - snap0.get("crypto.session.bootstrap_wraps", 0)
+        group = len(c.all_servers)
+        assert wraps < 2 * group, (
+            f"{wraps} bootstrap wraps after one peer restart — "
+            "the whole group degraded to bootstrap sealing"
+        )
+        assert cl.read(b"reseal/z") == b"v3"
+    finally:
+        c.stop()
+
+
+def test_pump_thread_lifecycle():
+    p = Presession(_FakeClient(), interval=0.01)
+    p.ensure_pump()
+    assert p._pump is not None and p._pump.is_alive()
+    p.ensure_pump()  # idempotent
+    p.stop()
+    p._pump.join(timeout=2)
+    assert not p._pump.is_alive()
+
+
+def test_pump_not_started_when_disabled(monkeypatch):
+    monkeypatch.setenv("BFTKV_PRESESSION", "off")
+    p = Presession(_FakeClient(), interval=0.01)
+    p.ensure_pump()
+    assert p._pump is None

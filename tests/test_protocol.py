@@ -65,6 +65,9 @@ def test_tofu_rejects_foreign_writer(cluster):
     (reference: server.go:329-337, mal_test.go TOFU scenario)."""
     owner, intruder = cluster.clients[0], cluster.clients[1]
     owner.write(b"test_tofu", b"mine")
+    # TOFU ownership is established by the CERTIFIED record (pending
+    # residue never owns — DESIGN.md §12); settle the async tail first.
+    owner.drain_tails()
     with pytest.raises(Error):
         intruder.write(b"test_tofu", b"stolen")
     assert owner.read(b"test_tofu") == b"mine"
@@ -88,6 +91,7 @@ def test_read_repair(cluster):
     (reference: client.go:281-302)."""
     cli = cluster.clients[0]
     cli.write(b"test_repair", b"healme")
+    cli.drain_tails()  # back-fill delivers the full-quorum copies
     victim = cluster.storage_servers[0]
     # wipe the victim's copy
     victim.storage._data.pop(b"test_repair", None)  # type: ignore[attr-defined]

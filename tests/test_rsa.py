@@ -128,3 +128,49 @@ def test_verify_batch_host_crossover(keys):
     sig = rsa.sign(b"m", key)
     ok = dom.verify_batch([(b"m", sig, key.public), (b"x", sig, key.public)])
     assert ok[0] and not ok[1]
+
+
+# -- native Montgomery modexp (native/montmodexp.c) -------------------------
+
+
+def test_native_modexp_matches_pow_oracle():
+    """The CIOS Montgomery extension is pinned to pow() across widths,
+    edge bases, and exponent shapes; the pure path stays the oracle."""
+    import random
+
+    if rsa._MM is None:
+        pytest.skip("native modexp not built")
+    rng = random.Random(1234)
+    for bits in (512, 1024, 2048):
+        for _ in range(10):
+            mod = rng.getrandbits(bits) | (1 << (bits - 1)) | 1
+            params = rsa._mont_params(mod)
+            for base in (
+                0,
+                1,
+                2,
+                mod - 1,
+                rng.getrandbits(bits) % mod,
+            ):
+                for exp in (1, 2, 65537, rng.getrandbits(bits)):
+                    assert rsa._native_powmod(base, exp, params) == pow(
+                        base, exp, mod
+                    ), (bits, base, exp)
+
+
+def test_native_sign_matches_pure_python(keys, monkeypatch):
+    """One signature, both engines, byte-identical — so an engine flip
+    (or BFTKV_NATIVE_MODEXP=off) can never change the wire."""
+    if rsa._MM is None:
+        pytest.skip("native modexp not built")
+    key = keys[0]
+    native = rsa.sign(b"engine parity", key)
+    monkeypatch.setattr(rsa, "_MM", None)
+    assert rsa.sign(b"engine parity", key) == native
+
+
+def test_crt_pow_d_roundtrips_encrypt(keys):
+    key = keys[0]
+    m = 0x123456789ABCDEF
+    c = pow(m, key.e, key.n)
+    assert rsa.crt_pow_d(c, key) == m

@@ -150,6 +150,7 @@ def test_sync_plane_is_shard_aware(cluster):
     # Something synced exists in both shards.
     for idx, keys in ks.items():
         c.write(keys[0], b"sync-seed")
+    c.drain_tails()  # sync moves CERTIFIED records; settle the tails
     rw_a = next(
         s
         for s in cluster.storage_servers

@@ -142,6 +142,7 @@ def test_convergence_after_missed_writes(cluster):
     M = 5
     for i in range(M):
         cl.write(b"conv%d" % i, b"val%d" % i)
+    cl.drain_tails()  # collapsed writes certify on the async tail
 
     victim.start()
     base = metrics.snapshot()
@@ -294,6 +295,7 @@ def test_byzantine_pull_rejected_state_unchanged(mal_cluster):
     c = mal_cluster
     cl = c.clients[0]
     cl.write(b"target", b"honest-value")
+    cl.drain_tails()  # the forged variants derive from the CERTIFIED record
 
     victim = c.server_named("rw01")
     MalSyncServer.mal_records = _tampered_records(c, b"target")

@@ -104,8 +104,10 @@ def test_visual_feed_end_to_end():
         types = {e["type"] for e in events}
         assert "graph" in types, events
         cmds = {e.get("command") for e in events if e["type"] == "request"}
-        # the narrated node served at least the write-path commands
-        assert {"time", "sign", "write"} & cmds, events
+        # the narrated node served at least one write-path command
+        # (write_sign = the collapsed round; time/sign/write = the
+        # classic rounds and the certify/back-fill deliveries)
+        assert {"time", "sign", "write", "write_sign", "batch_write"} & cmds, events
         graph_evt = next(e for e in events if e["type"] == "graph")
         assert any(n["self"] for n in graph_evt["nodes"])
         assert graph_evt["edges"]

@@ -7,12 +7,20 @@ something if someone reads it — this is the reader.  It compares the
 regression actually moves; kernel sections swing with the accelerator
 tunnel and are excluded by default) of two bench records and exits
 non-zero when any shared section regressed more than ``--threshold``
-(default 30%).
+(default 30%) on EITHER axis:
+
+- **throughput** (the headline ``writes_per_sec``-style number; lower
+  is worse), or
+- **write p50 latency** (``write_p50_s``; HIGHER is worse) — after the
+  round-collapse work, latency is a first-class deliverable and a
+  throughput-neutral latency regression must fail CI on its own.
 
 Accepted inputs, auto-detected per file:
 
 - a driver round record (``BENCH_rNN.json``): sections under
-  ``parsed.extra.sections``, each a compact ``[status, number]`` pair;
+  ``parsed.extra.sections``, each a compact ``[status, number]`` pair —
+  or ``[status, number, write_p50_s]`` once the round records carry the
+  latency axis (older two-element records simply skip the p50 gate);
 - a full bench record (``BENCH_detail.json`` / bench.py stderr line):
   sections under ``extra.sections`` as dicts;
 - a bare ``{"sections": {...}}`` dict.
@@ -39,7 +47,7 @@ def _backend_class(status: str) -> str:
 
 
 def extract_sections(doc: dict) -> dict:
-    """``{section name: (status, headline number | None)}``."""
+    """``{section name: (status, headline number | None, p50 | None)}``."""
     sections = None
     for path in (("parsed", "extra", "sections"), ("extra", "sections"),
                  ("sections",)):
@@ -54,22 +62,25 @@ def extract_sections(doc: dict) -> dict:
     out: dict = {}
     if sections is None:
         return out
+
+    def num(v):
+        return v if isinstance(v, (int, float)) else None
+
     for name, sec in sections.items():
-        if isinstance(sec, (list, tuple)) and len(sec) == 2:
-            status, num = sec
-            out[name] = (str(status), num if isinstance(
-                num, (int, float)
-            ) else None)
+        if isinstance(sec, (list, tuple)) and len(sec) in (2, 3):
+            status = sec[0]
+            p50 = num(sec[2]) if len(sec) == 3 else None
+            out[name] = (str(status), num(sec[1]), p50)
         elif isinstance(sec, dict):
             if "skipped" in sec:
-                out[name] = ("skip", None)
+                out[name] = ("skip", None, None)
                 continue
             if "error" in sec:
-                out[name] = ("err", None)
+                out[name] = ("err", None, None)
                 continue
-            num = sec.get("writes_per_sec")
-            if not isinstance(num, (int, float)):
-                num = next(
+            n = sec.get("writes_per_sec")
+            if not isinstance(n, (int, float)):
+                n = next(
                     (
                         v
                         for k, v in sec.items()
@@ -78,9 +89,11 @@ def extract_sections(doc: dict) -> dict:
                     ),
                     None,
                 )
-            out[name] = (str(sec.get("backend", "?")), num)
+            out[name] = (
+                str(sec.get("backend", "?")), n, num(sec.get("write_p50_s"))
+            )
         elif isinstance(sec, str):
-            out[name] = (sec, None)
+            out[name] = (sec, None, None)
     return out
 
 
@@ -102,7 +115,7 @@ def compare(
     for name in shared:
         if prefix and not name.startswith(prefix):
             continue
-        (sa, va), (sb, vb) = a[name], b[name]
+        (sa, va, pa), (sb, vb, pb) = a[name], b[name]
         if va is None or vb is None:
             lines.append(f"  {name}: no shared number "
                          f"({sa}:{va} -> {sb}:{vb}), skipped")
@@ -122,6 +135,19 @@ def compare(
         lines.append(
             f"  {name}: {va:g} -> {vb:g}  ({ratio:.2f}x)  {verdict}"
         )
+        # Latency axis: p50 compares only when BOTH records carry it —
+        # the metric appeared with the round-collapse work, and a
+        # missing side must not fail every historical comparison.
+        if pa is not None and pb is not None and pa > 0:
+            lratio = pb / pa
+            lverdict = "ok"
+            if lratio > 1.0 + threshold:
+                lverdict = f"REGRESSION (p50 >{threshold:.0%} slower)"
+                regressions.append(f"{name} (write p50)")
+            lines.append(
+                f"  {name} write p50: {pa:g}s -> {pb:g}s  "
+                f"({lratio:.2f}x)  {lverdict}"
+            )
     if not any(name.startswith(prefix) for name in shared):
         lines.append(f"  (no shared '{prefix}*' sections)")
     return lines, regressions, compared
@@ -130,12 +156,13 @@ def compare(
 def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         description="compare two bench JSON records; non-zero exit on "
-                    "cluster-section regression"
+                    "cluster-section regression (throughput or write p50)"
     )
     ap.add_argument("old")
     ap.add_argument("new")
     ap.add_argument("--threshold", type=float, default=0.30,
-                    help="maximum tolerated fractional drop (default 0.30)")
+                    help="maximum tolerated fractional regression on "
+                         "either axis (default 0.30)")
     ap.add_argument("--prefix", default="cluster",
                     help="only compare sections with this name prefix "
                          "(default: cluster; '' = all)")
