@@ -799,6 +799,158 @@ def bench_cluster(
             tmp.cleanup()
 
 
+def bench_cluster_gray(
+    n_servers: int = 4,
+    n_rw: int = 4,
+    writers: int = 8,
+    writes_per_writer: int = 10,
+    *,
+    value_size: int = 512,
+    delay_s: float = 0.35,
+) -> dict:
+    """Gray-failure section (DESIGN.md §13): one clique member of a
+    4-node loopback cluster delayed ``delay_s`` per inbound post (a
+    slow-but-ALIVE peer, ~5-10x a loopback p99) while writers run —
+    hedging + health-aware staging ON vs OFF, plus the recovery
+    plane's repair counters.  The headline rate is the hedged run;
+    ``tools/bench_compare.py`` treats this section as report-only."""
+    from bftkv_tpu import transport as tptr
+    from bftkv_tpu.faults import failpoint as fp
+    from bftkv_tpu.metrics import registry as metrics
+    from bftkv_tpu.ops import dispatch
+    from bftkv_tpu.storage.memkv import MemStorage
+    from bftkv_tpu.sync import SyncDaemon
+
+    servers, clients = _make_cluster(n_servers, n_rw, writers, MemStorage)
+    hedge_env = os.environ.get("BFTKV_HEDGE")
+    try:
+        dispatch.install(dispatch.VerifyDispatcher(max_batch=256))
+        dispatch.install_signer(dispatch.SignDispatcher(max_batch=128))
+        value = os.urandom(value_size)
+        for ci, c in enumerate(clients[:writers]):
+            c.write(b"gray/warm/%d" % ci, value)
+        for c in clients[:writers]:
+            c.drain_tails()
+        tptr.peer_latency.reset()
+
+        def run_phase(tag: str) -> tuple[float, float]:
+            """(p50 seconds, writes/s) over one threaded write burst."""
+            lats: list[list[float]] = [[] for _ in range(writers)]
+            errors: list = []
+
+            def run(ci: int, client) -> None:
+                try:
+                    for i in range(writes_per_writer):
+                        var = f"gray/{tag}/{ci}/{i}".encode()
+                        t0 = time.perf_counter()
+                        client.write(var, value)
+                        lats[ci].append(time.perf_counter() - t0)
+                except Exception as e:
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=run, args=(ci, c), daemon=True)
+                for ci, c in enumerate(clients[:writers])
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            for c in clients[:writers]:
+                c.drain_tails()
+            flat = sorted(x for l in lats for x in l)
+            return flat[len(flat) // 2], len(flat) / elapsed
+
+        # Fault-free floor (also seeds the latency tracker).
+        p50_free, _rate_free = run_phase("free")
+
+        # The gray member: the first clique seat of the owner quorum —
+        # guaranteed inside the minimal interleaved WRITE_SIGN prefix.
+        from bftkv_tpu import quorum as qmod
+
+        gray_node = qmod.choose_quorum_for(
+            clients[0].qs, b"gray/x", qmod.AUTH
+        ).nodes()[0]
+        target = fp.link_of(gray_node.address)
+
+        metrics.reset()
+        os.environ["BFTKV_HEDGE"] = "on"
+        fp.arm(17)
+        fp.registry.add(
+            "transport.send", "delay", match={"dst": target},
+            seconds=delay_s, rule_id=f"slow_node:{target}",
+        )
+        try:
+            p50_on, rate_on = run_phase("hedged")
+        finally:
+            fp.disarm()
+        snap_on = metrics.snapshot()
+        hedge_sent = sum(
+            v for k, v in snap_on.items()
+            if k.startswith("transport.hedge.sent")
+        )
+        hedge_wasted = sum(
+            v for k, v in snap_on.items()
+            if k.startswith("transport.hedge.wasted")
+        )
+
+        os.environ["BFTKV_HEDGE"] = "off"
+        tptr.peer_latency.reset()  # no carried gray flags for the control
+        fp.arm(17)
+        fp.registry.add(
+            "transport.send", "delay", match={"dst": target},
+            seconds=delay_s, rule_id=f"slow_node:{target}",
+        )
+        try:
+            p50_off, _rate_off = run_phase("unhedged")
+        finally:
+            fp.disarm()
+
+        # Recovery plane: one clique replica's repair pass certifies
+        # the commit-pending residue the collapsed writes leave on the
+        # sign plane (the client back-fill covers the write plane).
+        metrics.reset()
+        os.environ.pop("BFTKV_HEDGE", None)
+        repair_srv = servers[0]
+        SyncDaemon(repair_srv, interval=999).repair_once()
+        snap_rep = metrics.snapshot()
+
+        return {
+            "replicas": n_servers,
+            "rw_nodes": n_rw,
+            "writers": writers,
+            "writes": writers * writes_per_writer,
+            "gray_target": target,
+            "gray_delay_s": delay_s,
+            "writes_per_sec": round(rate_on, 2),
+            "write_p50_s": round(p50_on, 4),
+            "write_p50_hedge_off_s": round(p50_off, 4),
+            "write_p50_fault_free_s": round(p50_free, 4),
+            "gray_slowdown_hedged": round(p50_on / p50_free, 2)
+            if p50_free
+            else 0.0,
+            "gray_slowdown_unhedged": round(p50_off / p50_free, 2)
+            if p50_free
+            else 0.0,
+            "hedge_sent": hedge_sent,
+            "hedge_wasted": hedge_wasted,
+            "repair_certified": snap_rep.get("sync.repair.certified", 0),
+            "repair_demoted": snap_rep.get("sync.repair.demoted", 0),
+        }
+    finally:
+        if hedge_env is None:
+            os.environ.pop("BFTKV_HEDGE", None)
+        else:
+            os.environ["BFTKV_HEDGE"] = hedge_env
+        dispatch.uninstall_all()
+        for s in servers:
+            s.tr.stop()
+
+
 def bench_cluster_batch(
     n_servers: int,
     n_rw: int,
@@ -1300,6 +1452,7 @@ SECTION_NAMES = {
     "bmix64": "cluster_64_batched_mix",
     "bmix64ec": "cluster_64_batched_mix_ec",
     "cshards": "cluster_shards",
+    "c4gray": "cluster_4_gray",
     "thr": "threshold_5_9",
     "tally": "revoke_tally_256",
 }
@@ -1307,8 +1460,9 @@ SECTION_NAMES = {
 # Sections cheap enough to measure on CPU when the accelerator is
 # unreachable AND no cached TPU measurement exists (last resort).
 # cluster_shards is a self-relative scaling ratio, meaningful on any
-# backend.
-CPU_OK = {"tally", "c4", "cshards"}
+# backend; cluster_4_gray is hedged-vs-unhedged on the same box, also
+# self-relative.
+CPU_OK = {"tally", "c4", "cshards", "c4gray"}
 
 # Per-section subprocess timeouts (seconds).  The flapping tunnel makes
 # a hung section indistinguishable from a slow one until the timeout
@@ -1319,7 +1473,7 @@ CPU_OK = {"tally", "c4", "cshards"}
 TOKEN_TIMEOUT = {
     "kernel": 600, "modexp": 600, "tally": 600,
     "rns": 900, "sign": 900, "ec": 900, "thr": 900,
-    "c4": 900, "c4http": 900, "c4ec": 900, "c16": 900,
+    "c4": 900, "c4http": 900, "c4ec": 900, "c16": 900, "c4gray": 900,
     "b16": 1200, "b64": 1500, "bmix64": 1500, "bmix64ec": 1500,
     "c64": 1500, "mix64": 1500, "cshards": 1500,
 }
@@ -1399,6 +1553,13 @@ def _section_spec(token: str):
             shard_counts=(1, 2) if FAST else (1, 2, 4),
             writes_per_writer=3 if FAST else 6,
             zipf=zipf,
+        ),
+        # Gray failure: one slow-but-alive clique member; hedging +
+        # health-aware staging vs the fixed-timeout behavior, plus the
+        # repair daemon's certified/demoted counters (DESIGN.md §13).
+        "c4gray": lambda: bench_cluster_gray(
+            writers=4 if FAST else 8,
+            writes_per_writer=4 if FAST else 10,
         ),
         "b16": lambda: bench_cluster_batch(
             16, 4, 2 if FAST else 4, batch_size, 1 if FAST else 2
@@ -1546,7 +1707,9 @@ def main() -> None:
     use_cache = os.environ.get("BENCH_NO_CACHE") != "1"
 
     if FAST:
-        default_configs = "rns,sign,b16,kernel,modexp,ec,c4,c16,cshards,tally"
+        default_configs = (
+            "rns,sign,b16,kernel,modexp,ec,c4,c16,cshards,c4gray,tally"
+        )
     else:
         # Short kernel sections FIRST: the tunnel flaps and its live
         # windows have been minutes long, so each window should bank
@@ -1556,7 +1719,7 @@ def main() -> None:
         # BENCH_partial.json keeps whatever landed.
         default_configs = (
             "rns,sign,kernel,ec,modexp,b16,b64,bmix64,bmix64ec,"
-            "c4,c16,c64,c4http,c4ec,cshards,thr,tally"
+            "c4,c16,c64,c4http,c4ec,cshards,c4gray,thr,tally"
         )
     configs = [t for t in _env_list("BENCH_CONFIGS", default_configs)
                if t in SECTION_NAMES]

@@ -158,10 +158,29 @@ class Presession:
     def warm_once(self) -> int:
         """One pump round: re-seal every cold warm-set peer with a
         no-op NOTIFY post (the bootstrap envelope it forces is exactly
-        the session grant).  Returns how many peers were resealed."""
+        the session grant).  Returns how many peers were resealed.
+
+        Peers whose circuit breaker is currently OPEN are skipped
+        (``crypto.session.reseal_skipped``): a downed peer's bootstrap
+        envelope is pure wasted pump work — each round would burn an
+        RSA sign + OAEP wrap just to hit the breaker (or worse, eat a
+        timeout probing it).  The read-only ``is_open`` check never
+        consumes the breaker's half-open probe slot, so once the
+        breaker half-opens the peer re-enters the pump naturally."""
         from bftkv_tpu import transport as tp
 
         cold = self._cold_peers()
+        skipped = [
+            n
+            for n in cold
+            if tp.peer_health.is_open(getattr(n, "address", "") or "")
+        ]
+        if skipped:
+            metrics.incr(
+                "crypto.session.reseal_skipped", len(skipped)
+            )
+            open_ids = {id(n) for n in skipped}
+            cold = [n for n in cold if id(n) not in open_ids]
         if not cold:
             return 0
         metrics.incr("crypto.session.reseal", len(cold), labels={"cmd": "presession"})

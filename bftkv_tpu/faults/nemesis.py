@@ -54,6 +54,7 @@ STEP_KINDS = (
     "link_delay",
     "stale_replay",
     "collude",
+    "slow_node",
 )
 
 
@@ -75,33 +76,74 @@ class Nemesis:
         #: its window (built in :meth:`run`; None = detection off).
         self.collector = None
         self.detection: list[dict] = []
+        #: slow_node windows where a write failed: a gray member inside
+        #: the f budget must never BLOCK commit — slower is fine,
+        #: failed is a violation (the acceptance bar of DESIGN.md §13).
+        self.gray_blocked: list[dict] = []
 
     # -- deterministic planning -------------------------------------------
 
-    def plan(self, steps: int = 4) -> list[dict]:
+    def plan(self, steps: int = 4, kinds: tuple | None = None) -> list[dict]:
         """Pure function of (seed, cluster shape): the schedule replays
-        identically run to run.
+        identically run to run.  ``kinds`` restricts the step pool
+        (the slow_node-heavy CI soak uses it).
 
         ``stale_replay`` targets only the storage plane: single reads
         fan out to the read complement ``R = {Vi} − {Ci}`` (wotqs), so
         a read-replayer programmed onto a *quorum* server would never
         receive a read — a fault that cannot manifest exercises
-        nothing and is undetectable by construction."""
+        nothing and is undetectable by construction.
+
+        ``slow_node`` is the gray failure: the member stays ALIVE and
+        honest but every inbound link to it is delayed (~5-10x a
+        loopback p99); the ``write_sign`` mode is the gray colluder —
+        prompt on every command except the one on the write's critical
+        path."""
         rng = random.Random(self.seed)
+        kinds = tuple(kinds) if kinds else STEP_KINDS
         targets = sorted(self.cluster.names(storage_only=True))
         uni = getattr(self.cluster, "universe", None)
         storage = sorted(
             i.name for i in getattr(uni, "storage_nodes", ())
         ) or targets
+        clique = sorted(
+            i.name for i in getattr(uni, "servers", ())
+        ) or targets
+        # write_sign-mode gray colluders must sit in the staged WRITE
+        # wave or the fault cannot manifest (the staged fan-out asks
+        # the first 2f+1 clique members of the owner shard; a member
+        # outside that prefix never receives a WRITE_SIGN at all —
+        # same honesty rule as stale_replay's storage-plane scoping).
+        shard_groups = [
+            [i.name for i in g]
+            for g in (getattr(uni, "shards", None) or [])
+            if g
+        ] or [clique]
+        ws_pool = []
+        for names in shard_groups:
+            f_g = (len(names) - 1) // 3
+            ws_pool += names[: 2 * f_g + 1]
+        ws_pool = ws_pool or clique
         out = []
         for i in range(steps):
-            kind = STEP_KINDS[rng.randrange(len(STEP_KINDS))]
-            pool = storage if kind == "stale_replay" else targets
+            kind = kinds[rng.randrange(len(kinds))]
+            if kind == "stale_replay":
+                pool = storage
+            elif kind == "slow_node":
+                # Gray CLIQUE members are the interesting case: they
+                # sit on the WRITE_SIGN critical path.
+                mode = ("all", "write_sign")[rng.randrange(2)]
+                pool = ws_pool if mode == "write_sign" else clique
+            else:
+                pool = targets
             step = {"step": i, "kind": kind, "target": pool[rng.randrange(len(pool))]}
             if kind == "clock_skew":
                 step["delta"] = rng.choice([-1000, 1000, 1 << 20])
             elif kind == "link_delay":
                 step["seconds"] = round(0.01 + 0.04 * rng.random(), 4)
+            elif kind == "slow_node":
+                step["seconds"] = round(0.4 + 0.3 * rng.random(), 3)
+                step["mode"] = mode
             out.append(step)
         return out
 
@@ -134,6 +176,32 @@ class Nemesis:
                 seconds=seconds,
                 max_seconds=seconds * 3,
                 rule_id=rule_id or f"delay:{target}",
+            )
+        ]
+
+    def slow_node(
+        self,
+        target: str,
+        seconds: float,
+        mode: str = "all",
+        rule_id: str = "",
+    ) -> list[fp.Rule]:
+        """Gray failure: ``target`` stays alive and honest, but every
+        inbound post to it is delayed.  ``mode="write_sign"`` is the
+        gray *colluder* — prompt on every command except WRITE_SIGN,
+        so only the collapsed write's critical path suffers (a plain
+        liveness probe sees a healthy member)."""
+        match: dict = {"dst": target}
+        if mode == "write_sign":
+            match["cmd"] = "write_sign"
+        return [
+            self.registry.add(
+                "transport.send",
+                "delay",
+                match=match,
+                seconds=seconds,
+                max_seconds=seconds * 1.5,
+                rule_id=rule_id or f"slow_node:{target}",
             )
         ]
 
@@ -349,6 +417,34 @@ class Nemesis:
                     a["kind"] == "member_down" and a["source"] == target
                     for a in fresh
                 )
+            if kind == "slow_node":
+                # A gray member surfaces three ways: the injected-fault
+                # echo (fp registry); a gray_member anomaly from the
+                # transport.peer.slow delta, attributed to the peer in
+                # the detail string (the counter is recorded client-
+                # side, so the scrape source is the process); or the
+                # member simply BEING flagged gray at observe time —
+                # health-aware staging ranks a still-gray member out of
+                # the wave, so consecutive windows on one target may
+                # see no fresh traffic at all (the crash_restart
+                # being-down-at-scrape rule, gray form).
+                from bftkv_tpu import transport as _tp
+
+                try:
+                    srv = self.cluster.server_named(target)
+                    addr = getattr(srv.self_node, "address", "")
+                except Exception:
+                    addr = ""
+                if addr and _tp.peer_latency.is_gray(addr):
+                    return True
+                return any(
+                    (a["kind"] == "fault" and a["source"] == target)
+                    or (
+                        a["kind"] == "gray_member"
+                        and target in a["detail"]
+                    )
+                    for a in fresh
+                )
             return any(
                 a["kind"] == "fault" and a["source"] == target
                 for a in fresh
@@ -411,6 +507,27 @@ class Nemesis:
                 self._observe_window(step, seq0)
             finally:
                 self.heal(rules)
+        elif kind == "slow_node":
+            w0 = self.failures["write"]
+            rules = self.slow_node(
+                target, step["seconds"], step.get("mode", "all")
+            )
+            try:
+                self.traffic(tag)
+                self._observe_window(step, seq0)
+            finally:
+                self.heal(rules)
+            if self.failures["write"] > w0:
+                # ≤f gray members may make a write SLOW, never make it
+                # FAIL — the hedging/health-staging acceptance bar.
+                self.gray_blocked.append(
+                    {
+                        "step": step["step"],
+                        "target": target,
+                        "mode": step.get("mode", "all"),
+                        "failed_writes": self.failures["write"] - w0,
+                    }
+                )
         elif kind == "stale_replay":
             rules = byzantine.make_stale_replayer(self.registry, target)
             try:
@@ -429,19 +546,25 @@ class Nemesis:
             raise ValueError(f"unknown step kind {kind!r}")
 
     def run(
-        self, steps: int = 4, dwell: float = 0.0, detect: bool = True
+        self,
+        steps: int = 4,
+        dwell: float = 0.0,
+        detect: bool = True,
+        kinds: tuple | None = None,
     ) -> dict:
         """Arm, execute the seeded plan with traffic, repair, check.
         Returns a report dict (``violations`` empty = safe run;
         ``undetected`` empty = every fault surfaced in the health
-        plane's anomaly feed within its own window)."""
-        plan = self.plan(steps)
+        plane's anomaly feed within its own window; ``gray_blocked``
+        empty = no slow_node window ever blocked a commit)."""
+        plan = self.plan(steps, kinds=kinds)
         # Shard layout before the run: if it survives unchanged (no
         # membership churn rerouted the keyspace), the checker may apply
         # the strict one-shard-per-variable invariant.
         shard_map_before = self.cluster.shard_map()
         self.registry.arm(self.seed)
         self.detection = []  # a re-run must not inherit stale verdicts
+        self.gray_blocked = []
         self.collector = self._make_collector() if detect else None
         try:
             if self.collector is not None:
@@ -499,6 +622,7 @@ class Nemesis:
             "violations": violations,
             "detection": self.detection,
             "undetected": [d for d in self.detection if not d["detected"]],
+            "gray_blocked": self.gray_blocked,
             "anomalies": (
                 len(self.collector.anomalies())
                 if self.collector is not None
@@ -532,14 +656,25 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--no-detect", action="store_true",
                     help="skip the fleet-collector detection assertion "
                          "(safety checking only)")
+    ap.add_argument("--kinds", default="",
+                    help="comma-separated step-kind pool override "
+                         "(e.g. a slow_node-heavy soak: "
+                         "--kinds slow_node,link_delay,crash_restart)")
     args = ap.parse_args(argv)
+
+    kinds = tuple(
+        k.strip() for k in args.kinds.split(",") if k.strip()
+    ) or None
+    if kinds and any(k not in STEP_KINDS for k in kinds):
+        ap.error(f"--kinds must draw from {STEP_KINDS}")
 
     cluster = build_cluster(
         args.servers, 1, args.rw, bits=args.bits, n_shards=args.shards
     )
     try:
         report = Nemesis(cluster, seed=args.seed).run(
-            steps=args.steps, dwell=args.dwell, detect=not args.no_detect
+            steps=args.steps, dwell=args.dwell,
+            detect=not args.no_detect, kinds=kinds,
         )
     finally:
         cluster.stop()
@@ -547,6 +682,7 @@ def main(argv: list[str] | None = None) -> int:
         report["violations"]
         or not report["converged"]
         or report["undetected"]
+        or report["gray_blocked"]
     )
     if args.json:
         print(json.dumps(report, indent=2, default=repr))
@@ -566,6 +702,12 @@ def main(argv: list[str] | None = None) -> int:
             f"UNDETECTED: step {d['step']} {d['kind']} on {d['target']} "
             "never surfaced in the health feed"
         )
+    for g in report["gray_blocked"]:
+        print(
+            f"GRAY BLOCKED: step {g['step']} slow_node({g['mode']}) on "
+            f"{g['target']} failed {g['failed_writes']} write(s) — a "
+            "single gray member must never block commit"
+        )
     if report["violations"]:
         print("nemesis: SAFETY VIOLATIONS FOUND")
         return 1
@@ -575,9 +717,12 @@ def main(argv: list[str] | None = None) -> int:
     if report["undetected"]:
         print("nemesis: FAULTS INVISIBLE TO THE HEALTH PLANE")
         return 1
+    if report["gray_blocked"]:
+        print("nemesis: GRAY MEMBER BLOCKED COMMITS")
+        return 1
     print(
         "nemesis: ok (zero safety violations; every fault window "
-        "visible in the health feed)"
+        "visible in the health feed; no gray member blocked a commit)"
     )
     return 0
 

@@ -57,6 +57,15 @@ ANOMALY_COUNTERS = {
     # verifying ``suff`` share set: the record stays commit-pending
     # until a reader certifies it — worth an operator's attention.
     "client.tail.starved": "tail_starved",
+    # The repair daemon's verdict on residue that can NEVER certify
+    # (the SIGN round could not mint a verifying suff): same starved-
+    # tail condition, detected from the replica's seat instead of the
+    # writer's — only misbehavior or >f loss can produce it.
+    "sync.repair.demoted": "tail_starved",
+    # Gray failure: a peer whose observed RTT jumped far above its own
+    # baseline (transport/latency.py) — alive for the prober, poison
+    # for tail latency.  One event per gray episode, not per RPC.
+    "transport.peer.slow": "gray_member",
 }
 
 

@@ -108,12 +108,16 @@ _WRITE_PIPELINE_CHUNK = int(
 )
 
 
-def _staged_wave(qa) -> tuple[list, list]:
+def _staged_wave(qa, nodes: list | None = None) -> tuple[list, list]:
     """(wave1, rest) for a staged sign fan-out: the minimal prefix of
     the quorum whose full success would already be sufficient, and the
     remainder to ask only on shortfall.  Degenerates to (all, [])
-    when staging is disabled or no prefix suffices."""
-    nodes = qa.nodes()
+    when staging is disabled or no prefix suffices.  ``nodes``
+    overrides the ask order (health-aware staging) — the quorum
+    predicates still run over the same member set, so ordering can
+    never change *which* thresholds are required."""
+    if nodes is None:
+        nodes = qa.nodes()
     if _STAGED_SIGN_FANOUT:
         prefix: list = []
         for nd in nodes:
@@ -379,6 +383,56 @@ class Client(Protocol):
         self._tails: list[threading.Thread] = []
         self._tails_lock = threading.Lock()
         self._backfills = _BackfillCoalescer(self)
+        #: Optional /fleet member-status hints for health-aware staging
+        #: (``apply_fleet_snapshot``); the client's own breaker/latency
+        #: state works without them.
+        self._health_hints: dict[str, str] = {}
+
+    # -- health-aware staging (DESIGN.md §13) -----------------------------
+
+    def apply_fleet_snapshot(self, health: dict) -> None:
+        """Feed a fleet-collector health document
+        (``obs.FleetCollector.health()`` / the ``/fleet`` JSON) into
+        the staging order: members the fleet plane reports down go to
+        the back of every staged wave.  Entirely optional and
+        advisory — quorum thresholds are untouched."""
+        hints: dict[str, str] = {}
+        for sd in (health.get("shards") or {}).values():
+            for m in sd.get("members", ()):  # pragma: no branch
+                name = m.get("name")
+                if name:
+                    hints[name] = m.get("status", "")
+        self._health_hints = hints
+
+    def _rank_nodes(self, nodes: list) -> list:
+        """Health-aware ask order: open-circuit and fleet-reported-down
+        members last, gray (recently slow) members next-to-last,
+        cold-session peers after warm ones.  The sort is stable and
+        keys on health FLAGS only (never raw latency numbers), so with
+        no health signal the quorum's own order is preserved
+        bit-for-bit — deterministic fan-outs stay deterministic.
+        Ordering only changes which members land in the minimal first
+        wave — never which thresholds the quorum requires
+        (DESIGN.md §13.3)."""
+        if len(nodes) <= 1 or not tp.hedging_enabled():
+            return list(nodes)
+        msg = getattr(getattr(self.tr, "security", None), "message", None)
+        has_session = getattr(msg, "has_session", None)
+        hints = self._health_hints
+        plat = tp.peer_latency
+
+        def key(n):
+            addr = getattr(n, "address", "") or ""
+            down = tp.peer_health.is_open(addr) or (
+                hints.get(getattr(n, "name", ""), "") == "down"
+            )
+            cold = has_session is not None and not has_session(n.id)
+            return (
+                2 if down else (1 if plat.is_gray(addr) else 0),
+                cold,
+            )
+
+        return sorted(nodes, key=key)
 
     def drain_tails(self, timeout: float | None = 30.0) -> None:
         """Quiesce every outstanding async write tail (bounded)."""
@@ -554,12 +608,21 @@ class Client(Protocol):
             # signer sets intersecting in an honest node, not from how
             # many replicas were *asked* (DESIGN.md §9).  A fault in
             # the first wave costs one extra round to the remainder
-            # (BFTKV_SIGN_FANOUT=full restores the old behavior).
-            wave1, rest = _staged_wave(qa)
-            self.tr.multicast(tp.SIGN, wave1, req, cb)
-            if not done_flag[0] and rest:
+            # (BFTKV_SIGN_FANOUT=full restores the old behavior) — or,
+            # with a gray peer in the wave, one hedge delay
+            # (multicast_staged; DESIGN.md §13).  Health-aware order
+            # keeps known-slow/down members out of the first wave.
+            wave1, rest = _staged_wave(qa, self._rank_nodes(qa.nodes()))
+            stats = tp.multicast_staged(
+                self.tr,
+                tp.SIGN,
+                [wave1, rest],
+                req,
+                cb,
+                need_more=lambda: not done_flag[0],
+            )
+            if stats["expanded"] or stats["hedged"]:
                 metrics.incr("client.sign.fanout_expanded")
-                self.tr.multicast(tp.SIGN, rest, req, cb)
             with trace.span("verify.collective"):
                 try:
                     self.crypt.collective.verify(
@@ -662,9 +725,15 @@ class Client(Protocol):
                 self.qs, variable, qm.AUTH | qm.PEER
             )
             qw = qm.choose_quorum_for(self.qs, variable, qm.WRITE)
-        qa_nodes = qa.nodes()
+        # Health-aware staging: rank each plane before interleaving so
+        # open-breaker / gray members fall out of the minimal commit
+        # prefix (the quorums' memoized node lists are never mutated —
+        # _rank_nodes returns a sorted copy).
+        qa_nodes = self._rank_nodes(qa.nodes())
         qa_ids = {n.id for n in qa_nodes}
-        extra = [n for n in qw.nodes() if n.id not in qa_ids]
+        extra = [
+            n for n in self._rank_nodes(qw.nodes()) if n.id not in qa_ids
+        ]
         nodes = _interleave(qa_nodes, extra)
         self._presession.note_peers(nodes)
         self._presession.ensure_pump()
@@ -704,6 +773,9 @@ class Client(Protocol):
                     out.append(c)
             return out
 
+        def done_now() -> bool:
+            return committed() and qa.is_sufficient(share_certs())
+
         def cb(res: tp.MulticastResponse) -> bool:
             err = res.err
             if err is None and res.data is not None:
@@ -722,13 +794,18 @@ class Client(Protocol):
                         acks.append(res.peer)
                         if share_bytes:
                             add_share(share_bytes)
-                    return False
+                    # Consume until committed AND sufficient: every
+                    # response carries state (shares, decline hints),
+                    # but once the commit predicate holds, waiting for
+                    # a straggler buys nothing — the tail's back-fill
+                    # reaches it anyway (DESIGN.md §13.2).
+                    return done_now()
             if err == ERR_UNKNOWN_COMMAND:
                 legacy.append(res.peer)
                 self._legacy_peers.add(res.peer.id)
             errs.append(err)
             fails.append(res.peer)
-            return False  # consume the wave: every response carries state
+            return False
 
         wave1, rest = nodes, []
         if _STAGED_SIGN_FANOUT:
@@ -743,19 +820,24 @@ class Client(Protocol):
                     break
 
         with trace.span(
-            "phase.write_sign", attrs={"peers": len(wave1)}
+            "phase.write_sign",
+            attrs={"peers": len(nodes), "wave1": len(wave1)},
         ):
-            self.tr.multicast(tp.WRITE_SIGN, wave1, req, cb)
-        if rest and not (
-            committed() and qa.is_sufficient(share_certs())
-        ):
-            # Shortfall: expand to the remainder (the staged sign
-            # round's second wave, collapsed-path form).
+            # Staged + hedged: the remainder goes out on shortfall — or
+            # EARLY, after one hedge delay, when a wave-1 straggler
+            # (gray peer) stalls the round (transport.multicast_staged).
+            stats = tp.multicast_staged(
+                self.tr,
+                tp.WRITE_SIGN,
+                [wave1, rest],
+                req,
+                cb,
+                need_more=lambda: not done_now(),
+            )
+        if stats["expanded"] or stats["hedged"]:
             metrics.incr("client.piggyback.expanded")
-            with trace.span(
-                "phase.write_sign", attrs={"peers": len(rest), "wave": 2}
-            ):
-                self.tr.multicast(tp.WRITE_SIGN, rest, req, cb)
+        if stats["hedged"]:
+            metrics.incr("client.piggyback.hedged")
 
         if not committed():
             if legacy:
@@ -1069,8 +1151,9 @@ class Client(Protocol):
         ):
             # Staged fan-out, as in collect_signatures: a minimal
             # sufficient prefix signs first; the remainder is asked
-            # only if some item is still short.
-            wave1, rest = _staged_wave(qa)
+            # only if some item is still short.  Health-ranked, so a
+            # known-gray member never anchors the batch's first wave.
+            wave1, rest = _staged_wave(qa, self._rank_nodes(qa.nodes()))
             payload_bytes = pkt.serialize_list(reqs)
             cb = _batch_cb(stally, len(pending), on_share)
             self.tr.multicast(tp.BATCH_SIGN, wave1, payload_bytes, cb)
@@ -1684,10 +1767,15 @@ class Client(Protocol):
             return qa.reject(failure)
 
         with trace.span("read.certify", attrs={"peers": len(qa.nodes())}):
-            wave1, rest = _staged_wave(qa)
-            self.tr.multicast(tp.SIGN, wave1, req, cb)
-            if not done_flag[0] and rest:
-                self.tr.multicast(tp.SIGN, rest, req, cb)
+            wave1, rest = _staged_wave(qa, self._rank_nodes(qa.nodes()))
+            tp.multicast_staged(
+                self.tr,
+                tp.SIGN,
+                [wave1, rest],
+                req,
+                cb,
+                need_more=lambda: not done_flag[0],
+            )
             try:
                 self.crypt.collective.verify(
                     tbss, ss, qa, self.crypt.keyring

@@ -114,6 +114,59 @@ class Server(Protocol):
                 self._sync = DigestTree(self.storage)
             return self._sync
 
+    def pending_variables(
+        self,
+        limit: int = 4096,
+        after: bytes | None = None,
+        scan_window: int | None = None,
+    ) -> tuple[list[tuple[bytes, int, bytes, object]], bytes | None]:
+        """Commit-pending residue in this replica's own store: the
+        latest version of every variable whose record carries a
+        partial (non-completed) collective signature — a piggybacked
+        write whose async back-fill never landed here.  The repair
+        daemon (sync/daemon.py) certifies or demotes these.
+
+        The scan is WINDOWED so steady state stays cheap: at most
+        ``scan_window`` keys (sorted order, resuming after ``after``)
+        are read+parsed per call — a large store of fully certified
+        records costs one bounded slice per repair round, not a
+        full-store parse sweep.  Returns ``(pending, next_cursor)``;
+        ``next_cursor`` is None when the scan reached the end of the
+        keyspace (the caller wraps around next round).
+
+        Excluded by design: hidden-prefix (threshold-CA) state,
+        TPA-protected records (certifying them needs the client's auth
+        proof, which only a client holds), legacy sign-phase residue
+        (``ss is None`` — the read path's scan-back + certify-on-read
+        already owns that shape), and anything unparsable."""
+        out: list[tuple[bytes, int, bytes, object]] = []
+        try:
+            keys = sorted(self.storage.keys())
+        except Exception:
+            return out, None
+        if after is not None:
+            keys = [k for k in keys if k > after]
+        cursor = None
+        if scan_window is not None and len(keys) > scan_window:
+            keys = keys[:scan_window]
+            cursor = keys[-1]  # more keys remain past this window
+        for variable in keys:
+            if len(out) >= limit:
+                break
+            if variable.startswith(HIDDEN_PREFIX):
+                continue
+            try:
+                raw = self.storage.read(variable, 0)
+                p = pkt.parse(raw)
+            except Exception:
+                continue
+            if p.sig is None or p.auth is not None:
+                continue
+            if p.ss is None or p.ss.completed:
+                continue
+            out.append((variable, p.t, raw, p))
+        return out, cursor
+
     # -- lifecycle (reference: server.go:47-62) ---------------------------
 
     def start(self, bind_host: str = "") -> None:

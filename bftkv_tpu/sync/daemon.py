@@ -35,8 +35,10 @@ Metrics: ``sync.rounds``, ``sync.pull.records`` (admitted),
 from __future__ import annotations
 
 import logging
+import os
 import random
 import threading
+import time
 
 from bftkv_tpu import packet as pkt
 from bftkv_tpu import quorum as qm
@@ -46,9 +48,17 @@ from bftkv_tpu.faults import failpoint as fp
 from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.sync.digest import HIDDEN_PREFIX, latest_completed
 
-__all__ = ["SyncDaemon", "admit_records"]
+__all__ = ["SyncDaemon", "admit_records", "repair_enabled"]
 
 log = logging.getLogger("bftkv_tpu.sync")
+
+
+def repair_enabled() -> bool:
+    """``BFTKV_REPAIR`` — the pending-residue repair plane (default
+    on).  ``BFTKV_REPAIR_AFTER`` sets the grace window in seconds."""
+    return os.environ.get("BFTKV_REPAIR", "on").lower() not in (
+        "off", "0", "false",
+    )
 
 #: Upper bounds on one pull response: record count AND total bytes.
 #: The transport has already buffered the body by the time these apply
@@ -167,19 +177,44 @@ def admit_records(server, records: list[bytes]) -> dict:
 class SyncDaemon:
     """Background anti-entropy driver for one server."""
 
+    #: Bound on the pending-residue scan per repair round.
+    REPAIR_SCAN_MAX = 4096
+
     def __init__(
         self,
         server,
         interval: float = 30.0,
         jitter: float = 0.5,
         rng: random.Random | None = None,
+        repair_after: float | None = None,
     ):
         self.server = server
         self.interval = interval
         self.jitter = jitter
+        if repair_after is None:
+            repair_after = float(
+                os.environ.get("BFTKV_REPAIR_AFTER", "5") or 5
+            )
+        #: Grace window: a pending record younger than this (measured
+        #: from when THIS daemon first observed it — storage records
+        #: carry no wall clock) is presumed to be a live write's tail
+        #: still in flight and left alone.
+        self.repair_after = repair_after
         self._rng = rng or random.Random()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # variable -> monotonic time first seen pending.
+        self._pending_seen: dict[bytes, float] = {}
+        # (variable, t) this daemon demoted: never-certifiable residue
+        # is tried once, surfaced once, and not retried every round.
+        self._demoted: set[tuple[bytes, int]] = set()
+        self._backfills = None  # lazy _BackfillCoalescer(server)
+        # Windowed-scan cursor (None = start of keyspace) and the
+        # variables seen pending so far in the current scan CYCLE —
+        # watch-list eviction is only sound once a cycle covered the
+        # whole keyspace.
+        self._scan_cursor: bytes | None = None
+        self._cycle_live: set[bytes] = set()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -214,6 +249,10 @@ class SyncDaemon:
                 self.run_round()
             except Exception:
                 log.exception("anti-entropy round failed")
+            try:
+                self.repair_round()
+            except Exception:
+                log.exception("repair round failed")
 
     # -- one round ---------------------------------------------------------
 
@@ -343,3 +382,232 @@ class SyncDaemon:
                 stats[k] += got[k]
         metrics.incr("sync.rounds")
         return stats
+
+    # -- pending-residue repair (DESIGN.md §13.1) --------------------------
+    #
+    # A writer that crashes after the 2f+1 commit but before its async
+    # back-fill leaves commit-PENDING residue on the quorum: a record
+    # the plane has accepted but that carries no verifying collective
+    # signature yet.  Before this plane, such a record was certified
+    # only if some client happened to READ the variable (certify-on-
+    # read) — anti-entropy never ships pending records, so convergence
+    # depended on client liveness.  The repair round closes that: each
+    # replica scans ITS OWN store for pending residue past the grace
+    # window, runs the same idempotent SIGN round the read path uses to
+    # mint a verifying collective signature, back-fills the certified
+    # record plane-wide through the back-fill coalescer, and demotes
+    # residue that cannot reach ``suff`` (``sync.repair.demoted``
+    # feeds the fleet feed's ``tail_starved`` anomaly).  Safety: the
+    # SIGN round re-collects shares for the EXACT stored <x, v, t,
+    # sig> (honest replicas already signed it — re-signing the exact
+    # stored pair is the one re-sign the equivocation rule permits),
+    # and the back-fill rides the same certified-beats-residue /
+    # upgrade-in-place admission rules every write already obeys, so
+    # concurrent repairs from several replicas are idempotent races.
+
+    def repair_once(self) -> dict:
+        """One repair pass ignoring the grace window (tests, CLI)."""
+        return self.repair_round(force=True)
+
+    def repair_round(self, *, force: bool = False) -> dict:
+        stats = {"scanned": 0, "certified": 0, "demoted": 0,
+                 "waiting": 0, "retrying": 0}
+        if not repair_enabled():
+            return stats
+        srv = self.server
+        now = time.monotonic()
+        # Windowed scan: at most REPAIR_SCAN_MAX keys read+parsed per
+        # round, resuming where the last round stopped — a big fully-
+        # certified store costs one bounded slice per round, never a
+        # full sweep.
+        pending, self._scan_cursor = srv.pending_variables(
+            limit=self.REPAIR_SCAN_MAX,
+            after=self._scan_cursor,
+            scan_window=self.REPAIR_SCAN_MAX,
+        )
+        cycle_done = self._scan_cursor is None
+        due: list[tuple[bytes, int, bytes, object]] = []
+        for variable, t, raw, p in pending:
+            self._cycle_live.add(variable)
+            if (variable, t) in self._demoted:
+                continue
+            stats["scanned"] += 1
+            first = self._pending_seen.setdefault(variable, now)
+            if force or now - first >= self.repair_after:
+                due.append((variable, t, raw, p))
+            else:
+                stats["waiting"] += 1
+        # Residue that resolved on its own (back-fill landed, a newer
+        # write certified) leaves the watch list — judged only once a
+        # scan CYCLE has covered the whole keyspace (absence from one
+        # window just means "not in this window").
+        if cycle_done:
+            for v in list(self._pending_seen):
+                if v not in self._cycle_live:
+                    del self._pending_seen[v]
+            self._cycle_live = set()
+        if not due:
+            return stats
+        certified: list[tuple[bytes, bytes]] = []
+        with trace.span("sync.repair", attrs={"due": len(due)}):
+            for variable, t, raw, p in due:
+                verdict, rec = self._certify_record(variable, t, raw, p)
+                if verdict == "certified":
+                    stats["certified"] += 1
+                    metrics.incr("sync.repair.certified")
+                    certified.append((variable, rec))
+                    self._pending_seen.pop(variable, None)
+                elif verdict == "refused":
+                    # The quorum ANSWERED and would not endorse the
+                    # record (bad writer signature, conflicting value):
+                    # only misbehavior can produce this — surface it
+                    # exactly once and stop burning quorum signs on it.
+                    # The record stays gated client-side (resolve
+                    # demotes uncertifiable pending buckets), so
+                    # nothing unbacked is ever served off it.
+                    stats["demoted"] += 1
+                    metrics.incr("sync.repair.demoted")
+                    self._demoted.add((variable, t))
+                    self._pending_seen.pop(variable, None)
+                    log.warning(
+                        "repair: demoted uncertifiable pending "
+                        "residue %r (t=%d)", variable, t,
+                    )
+                else:
+                    # Quorum UNREACHABLE (timeouts, partition, circuit
+                    # open): that is an outage, not a verdict — a
+                    # transient blip must not permanently demote
+                    # healthy residue or raise a false misbehavior
+                    # anomaly.  Leave the watch entry; the next round
+                    # retries after the partition heals.
+                    stats["retrying"] += 1
+                    metrics.incr("sync.repair.retry")
+        if certified:
+            self._backfill(certified)
+        return stats
+
+    #: Transport-level failure messages: an outage, never a verdict.
+    _OUTAGE_ERRS = frozenset(
+        e.message
+        for e in (
+            tp.ERR_UNREACHABLE,
+            tp.ERR_RPC_TIMEOUT,
+            tp.ERR_SERVER_ERROR,
+            tp.ERR_PEER_OPEN,
+        )
+    )
+
+    def _certify_record(
+        self, variable: bytes, t: int, raw: bytes, p
+    ):
+        """Mint a verifying collective signature for one pending record
+        via the idempotent SIGN round (the certify-on-read recipe, run
+        from the replica's seat) and persist the certified bytes
+        locally through the full write-path checks.  Returns
+        ``("certified", record)`` on success, ``("refused", None)``
+        when some quorum member ANSWERED and would not endorse the
+        record (demotable misbehavior), or ``("outage", None)`` when
+        the round failed on transport errors alone — a partition or
+        timeout blip that the caller must retry, never demote."""
+        srv = self.server
+        # Plain AUTH: the owner clique from the replica's own seat (the
+        # client-shaped AUTH|PEER view is empty on a server — same
+        # quorum flags admit_records verifies with).
+        qa = qm.choose_quorum_for(srv.qs, variable, qm.AUTH)
+        req = pkt.serialize(variable, p.value, t, p.sig, None)
+        tbss = pkt.tbss(raw)
+        ss = None
+        done_flag = [False]
+        failure: list = []
+        refused = [0]
+
+        def cb(res: tp.MulticastResponse) -> bool:
+            nonlocal ss
+            if res.err is None and res.data is not None:
+                try:
+                    share = pkt.parse_signature(res.data)
+                    ss, done = srv.crypt.collective.combine(
+                        ss, share, qa, srv.crypt.keyring
+                    )
+                    done_flag[0] = done
+                    return done
+                except Exception:
+                    # An unusable share IS an answer from a reachable
+                    # peer — the refusal class, not an outage.
+                    refused[0] += 1
+            elif (
+                getattr(res.err, "message", None)
+                not in self._OUTAGE_ERRS
+            ):
+                # Interned protocol error (equivocation, invalid
+                # signature, bad timestamp, ...): the peer answered
+                # and said no.
+                refused[0] += 1
+            failure.append(res.peer)
+            return qa.reject(failure)
+
+        with trace.span("sync.repair.sign", attrs={"t": t}):
+            srv.tr.multicast(tp.SIGN, qa.nodes(), req, cb)
+            try:
+                srv.crypt.collective.verify(
+                    tbss, ss, qa, srv.crypt.keyring
+                )
+            except Exception:
+                return ("refused" if refused[0] else "outage", None)
+        ss.completed = True
+        rec = pkt.serialize(variable, p.value, t, p.sig, ss)
+        try:
+            # Local admission first (timestamp / equivocation / TOFU /
+            # upgrade-in-place — exactly what the write handler runs);
+            # local state may have legitimately moved past this record,
+            # in which case the no-op answer is the correct one.
+            out = srv._write_storage_checks(
+                variable, p.value, t, p.sig, ss, rec
+            )
+            if out is not None:
+                srv._persist(variable, t, out)
+        except Exception:
+            log.exception("repair: local admission of %r failed", variable)
+        return "certified", rec
+
+    def _backfill(self, items: list[tuple[bytes, bytes]]) -> None:
+        """Push certified records plane-wide through the same back-fill
+        coalescer the collapsed write's async tail uses (one batched
+        single-shard BATCH_WRITE round per group); bounded-blocking so
+        a repair round leaves a settled plane behind it."""
+        from bftkv_tpu.protocol.client import _BackfillCoalescer
+
+        if self._backfills is None:
+            # The coalescer only touches .qs and .tr — a Server
+            # satisfies that surface exactly like a Client.
+            self._backfills = _BackfillCoalescer(self.server)
+        for variable, rec in items:
+            self._backfills.submit(variable, rec)
+        self._backfills.drain(timeout=15.0)
+        # The coalescer covers the WRITE plane; the sign quorum's
+        # members hold the pending residue too (and the repair SIGN
+        # round just re-marked it in-progress there), so the certified
+        # bytes must reach them as well or a clique member outside the
+        # write plane would keep residue until some client read it.
+        # Grouped per owning shard, exactly like the coalescer: a
+        # BATCH_WRITE frame is verified against ONE owner quorum
+        # server-side (a sharded replica's store only holds owned
+        # variables, so this is one group in practice — the grouping
+        # guards duck-typed quorum systems without that invariant).
+        srv = self.server
+        shard_of = getattr(srv.qs, "shard_of", None)
+        groups: dict[object, list[tuple[bytes, bytes]]] = {}
+        for variable, rec in items:
+            key = shard_of(variable) if shard_of is not None else None
+            groups.setdefault(key, []).append((variable, rec))
+        for group in groups.values():
+            qa = qm.choose_quorum_for(srv.qs, group[0][0], qm.AUTH)
+            with trace.span(
+                "sync.repair.backfill", attrs={"batch": len(group)}
+            ):
+                srv.tr.multicast(
+                    tp.BATCH_WRITE,
+                    qa.nodes(),
+                    pkt.serialize_list([rec for _v, rec in group]),
+                    None,
+                )

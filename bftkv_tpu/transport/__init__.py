@@ -36,6 +36,11 @@ from bftkv_tpu import trace
 from bftkv_tpu.errors import ERR_UNKNOWN_SESSION, new_error
 from bftkv_tpu.faults import failpoint as fp
 from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu.transport.latency import (
+    adaptive_enabled,
+    hedging_enabled,
+    peer_latency,
+)
 
 __all__ = [
     "JOIN",
@@ -64,11 +69,16 @@ __all__ = [
     "Transport",
     "TransportServer",
     "multicast",
+    "multicast_staged",
     "record_rpc",
     "instrument_handler",
     "RetryPolicy",
     "PeerHealth",
     "peer_health",
+    "peer_latency",
+    "adaptive_enabled",
+    "hedging_enabled",
+    "current_deadline",
     "default_retry_policy",
 ]
 
@@ -290,6 +300,21 @@ class PeerHealth:
         if opened:
             metrics.incr("transport.peer.opens")
 
+    def is_open(self, addr: str) -> bool:
+        """Read-only open check — unlike :meth:`allow`, never consumes
+        the half-open probe slot.  Health-aware staging and the
+        presession pump use this to *look* without probing; the actual
+        post still goes through ``allow()``."""
+        if not self.enabled:
+            return False
+        with self._lock:
+            st = self._states.get(addr)
+            return (
+                st is not None
+                and st[0] >= self.threshold
+                and time.monotonic() < st[1]
+            )
+
     def open_peers(self) -> list[str]:
         with self._lock:
             now = time.monotonic()
@@ -482,17 +507,12 @@ def multicast(
     # to a full per-recipient bootstrap re-encryption.
     grouped: list | None = None
     if len(mdata) == 1 and len(peers) > 1:
-        sec = getattr(tr, "security", None)
-        msg_sec = getattr(sec, "message", None)
-        if msg_sec is not None and hasattr(msg_sec, "encrypt_grouped"):
-            nonce = tr.generate_random()
-            payload = mdata[0] or b""
-            if ctx is not None:
-                payload = pkt.wrap_trace(ctx.trace_id, ctx.span_id, payload)
-            try:
-                grouped = msg_sec.encrypt_grouped(peers, payload, nonce)
-            except Exception:
-                grouped = None  # fall back to the whole-set encrypt
+        payload = mdata[0] or b""
+        if ctx is not None:
+            payload = pkt.wrap_trace(ctx.trace_id, ctx.span_id, payload)
+        grouped, g_nonce = _seal_grouped(tr, peers, payload)
+        if grouped is not None:
+            nonce = g_nonce  # fall back to the whole-set encrypt
     if (
         not fp.ARMED
         and getattr(tr, "INLINE_FANOUT", False)
@@ -522,30 +542,54 @@ def multicast(
                 launched += 1
                 continue
 
-        def work(peer=peer, cipher=cipher, nonce=nonce, payload=payload):
-            addr = getattr(peer, "address", "")
-            if not addr:
-                ch.put(MulticastResponse(peer, None, ERR_NO_ADDRESS()))
-                return
-            if ctx is None:
-                _post_one(tr, name, peer, addr, cipher, nonce, payload, ch)
-                return
-            # Pool workers are reused across requests: attach() both
-            # parents this span to the captured context and shields the
-            # thread from any context a previous task leaked.
-            with trace.attach(ctx), trace.span(
-                f"rpc.{name}",
-                attrs={"peer": getattr(peer, "name", "") or addr},
-            ):
-                _post_one(tr, name, peer, addr, cipher, nonce, payload, ch)
-
-        _pool.submit(work)
+        _launch_post(tr, name, peer, cipher, nonce, payload, ctx, ch)
         launched += 1
 
     for _ in range(launched):
         mr = ch.get()
         if cb is not None and cb(mr):
             break  # early exit; remaining posts finish in their threads
+
+
+def _seal_grouped(tr, peers: list, payload: bytes):
+    """Attempt the warm/cold grouped sealing of one shared payload to
+    the whole peer set.  Returns ``(per-peer ciphers, nonce)`` or
+    ``(None, None)`` when the security layer cannot group (caller
+    falls back to per-peer sealing).  Shared by :func:`multicast` and
+    :func:`multicast_staged` so the fallback semantics cannot drift."""
+    sec = getattr(tr, "security", None)
+    msg_sec = getattr(sec, "message", None)
+    if msg_sec is None or not hasattr(msg_sec, "encrypt_grouped"):
+        return None, None
+    nonce = tr.generate_random()
+    try:
+        return msg_sec.encrypt_grouped(peers, payload, nonce), nonce
+    except Exception:
+        return None, None
+
+
+def _launch_post(tr, name, peer, cipher, nonce, payload, ctx, ch) -> None:
+    """Submit one peer's post to the fan-out pool, traced.  Pool
+    workers are reused across requests: attach() both parents the span
+    to the captured context and shields the thread from any context a
+    previous task leaked.  Shared by :func:`multicast` and
+    :func:`multicast_staged`."""
+
+    def work():
+        addr = getattr(peer, "address", "")
+        if not addr:
+            ch.put(MulticastResponse(peer, None, ERR_NO_ADDRESS()))
+            return
+        if ctx is None:
+            _post_one(tr, name, peer, addr, cipher, nonce, payload, ch)
+            return
+        with trace.attach(ctx), trace.span(
+            f"rpc.{name}",
+            attrs={"peer": getattr(peer, "name", "") or addr},
+        ):
+            _post_one(tr, name, peer, addr, cipher, nonce, payload, ch)
+
+    _pool.submit(work)
 
 
 def _seal_one(tr, peers: list, mdata: list, i: int, ctx):
@@ -652,7 +696,21 @@ def _multicast_inline(
     _pool.submit(post_tail)
 
 
-def _inject_send_fault(tr, url, data, name, addr):
+#: Per-RPC deadline override, set by ``_send`` around each post so a
+#: transport backend (TrHTTP) can honor the *adaptive* per-peer
+#: deadline without a signature change to ``post()``.
+_tls_deadline = threading.local()
+
+
+def current_deadline(default: float) -> float:
+    """The effective deadline for the RPC in flight on this thread:
+    the adaptive per-peer deadline when one was computed, else
+    ``default`` (the transport's fixed ``rpc_timeout``)."""
+    v = getattr(_tls_deadline, "value", None)
+    return default if v is None else v
+
+
+def _inject_send_fault(tr, url, data, name, addr, deadline=None):
     """``transport.send`` failpoint: per-link drop / delay / duplicate /
     corrupt.  Returns the (possibly corrupted) payload to post, or
     raises the injected transport error."""
@@ -668,7 +726,8 @@ def _inject_send_fault(tr, url, data, name, addr):
         raise ERR_UNREACHABLE
     if act.kind == "delay":
         secs = fp.delay_seconds(act)
-        deadline = getattr(tr, "rpc_timeout", None)
+        if deadline is None:
+            deadline = getattr(tr, "rpc_timeout", None)
         if deadline is not None and secs >= deadline:
             # The peer "answers" after the deadline: the caller sees a
             # timeout, never the late bytes (loopback's analog of the
@@ -691,22 +750,44 @@ def _inject_send_fault(tr, url, data, name, addr):
 
 
 def _send(tr, url, cipher, name, addr) -> bytes:
-    """One logical post: fault injection, circuit-breaker accounting,
-    and bounded jittered-backoff retries on *transient* transport
-    errors (server error / unreachable / rpc timeout — never interned
-    protocol errors, which are answers)."""
+    """One logical post: fault injection, adaptive per-peer deadline,
+    RTT recording, circuit-breaker accounting, and bounded
+    jittered-backoff retries on *transient* transport errors (server
+    error / unreachable / rpc timeout — never interned protocol
+    errors, which are answers)."""
     policy = getattr(tr, "retry_policy", None) or default_retry_policy
+    base_timeout = getattr(tr, "rpc_timeout", None)
+    deadline = (
+        peer_latency.deadline(addr, base_timeout)
+        if base_timeout is not None
+        else None
+    )
     attempt = 0
     while True:
+        t0 = time.perf_counter()
         try:
             data = cipher
             if fp.ARMED:
-                data = _inject_send_fault(tr, url, data, name, addr)
-            res = tr.post(url, data)
+                data = _inject_send_fault(tr, url, data, name, addr, deadline)
+            _tls_deadline.value = deadline
+            try:
+                res = tr.post(url, data)
+            finally:
+                _tls_deadline.value = None
+            # Every successful post seeds the per-peer latency tracker
+            # — this is where the connection pool's observed RTTs feed
+            # the adaptive deadlines and hedge delays.
+            peer_latency.record(addr, time.perf_counter() - t0)
             peer_health.ok(addr)
             return res
         except Exception as e:
             transient = getattr(e, "message", None) in _TRANSIENT
+            if getattr(e, "message", None) == ERR_RPC_TIMEOUT.message:
+                # A deadline expiry IS a latency sample: the RTT was at
+                # least the deadline, and the gray flag must trip.
+                peer_latency.record(
+                    addr, time.perf_counter() - t0, timeout=True
+                )
             attempt += 1
             if not transient or attempt > policy.retries:
                 if transient:
@@ -721,6 +802,153 @@ def _send(tr, url, cipher, name, addr) -> bytes:
                 raise
             metrics.incr("transport.retries", labels={"cmd": name})
             time.sleep(policy.delay(attempt))
+
+
+def _any_unhealthy(peers: list) -> bool:
+    """Whether any peer in the set is currently flagged unhealthy —
+    open circuit breaker or gray (recently slow).  The hedged driver
+    costs thread hand-offs the healthy inline path avoids, so it only
+    engages when there is something to hedge against (or chaos is
+    armed, where per-link delays need the threaded path anyway)."""
+    for p in peers:
+        addr = getattr(p, "address", "") or ""
+        if addr and (peer_health.is_open(addr) or peer_latency.is_gray(addr)):
+            return True
+    return False
+
+
+def multicast_staged(
+    tr,
+    cmd: int,
+    waves: list[list],
+    data: bytes | None,
+    cb: Callable[[MulticastResponse], bool] | None,
+    *,
+    need_more: Callable[[], bool] | None = None,
+    hedge: bool = True,
+) -> dict:
+    """Staged single-payload fan-out with hedging (DESIGN.md §13).
+
+    ``waves`` is an ordered list of peer lists: wave 0 is the minimal
+    prefix whose full success already satisfies the caller; later
+    waves are asked only on shortfall.  ``need_more()`` is the
+    shortfall predicate, consulted at every wave boundary; ``cb``
+    follows :func:`multicast` semantics (returning True stops the
+    fan-in), and the driver additionally stops once ``need_more()``
+    goes False — a satisfied caller must not keep blocking on a
+    straggler's response.
+
+    With hedging armed (``BFTKV_HEDGE``, and either chaos armed or
+    some peer flagged unhealthy), the waves run on the threaded pool
+    and waiting longer than the peers' p99-derived hedge delay for the
+    next response launches the next wave EARLY (``transport.hedge.sent``)
+    instead of blocking on the straggler.  Amplification stays bounded
+    by construction: the union of all waves is exactly the peer set a
+    non-staged fan-out always posted to, so hedging can never exceed
+    the classic ask-everyone cost; ``transport.hedge.wasted`` counts
+    hedged posts whose responses went unused.  Otherwise the waves run
+    as plain sequential multicasts (the pre-hedging behavior, inline
+    fan-out included).
+
+    Returns ``{"hedged": n, "wasted": n, "expanded": bool,
+    "threaded": bool}``.
+    """
+    waves = [list(w) for w in waves if w]
+    stats = {"hedged": 0, "wasted": 0, "expanded": False, "threaded": False}
+    if not waves:
+        return stats
+    if need_more is None:
+        need_more = lambda: True  # noqa: E731
+    name = COMMAND_NAMES.get(cmd)
+    if name is None:
+        raise new_error("transport: unknown command")
+    flat = [p for w in waves for p in w]
+    if (
+        not (hedge and hedging_enabled())
+        or len(waves) == 1
+        or not (fp.ARMED or _any_unhealthy(flat))
+    ):
+        multicast(tr, cmd, waves[0], [data], cb)
+        for w in waves[1:]:
+            if not need_more():
+                break
+            stats["expanded"] = True
+            multicast(tr, cmd, w, [data], cb)
+        return stats
+
+    stats["threaded"] = True
+    ctx = trace.capture()
+    ch: "queue.SimpleQueue[MulticastResponse]" = queue.SimpleQueue()
+    payload = data or b""
+    if ctx is not None:
+        payload = pkt.wrap_trace(ctx.trace_id, ctx.span_id, payload)
+    # Grouped sealing over the whole union (the same warm/cold session
+    # split the plain single-payload multicast uses); per-peer sealing
+    # is the fallback.
+    grouped, nonce = _seal_grouped(tr, flat, payload)
+    offsets: list[int] = []
+    off = 0
+    for w in waves:
+        offsets.append(off)
+        off += len(w)
+
+    def launch(base: int, peers_w: list) -> None:
+        for j, peer in enumerate(peers_w):
+            if grouped is not None:
+                cipher, pn = grouped[base + j], nonce
+            else:
+                try:
+                    pn = tr.generate_random()
+                    cipher = tr.encrypt([peer], payload, pn)
+                except Exception as e:
+                    ch.put(MulticastResponse(peer, None, e))
+                    continue
+            _launch_post(tr, name, peer, cipher, pn, payload, ctx, ch)
+
+    launch(offsets[0], waves[0])
+    outstanding = len(waves[0])
+    next_wave = 1
+    hedged_ids: set[int] = set()
+    answered_hedged = 0
+    delay = peer_latency.hedge_delay(
+        [getattr(p, "address", "") or "" for p in waves[0]]
+    )
+    while outstanding > 0 or (next_wave < len(waves) and need_more()):
+        if outstanding == 0:
+            stats["expanded"] = True  # classic shortfall expansion
+            launch(offsets[next_wave], waves[next_wave])
+            outstanding += len(waves[next_wave])
+            next_wave += 1
+            continue
+        can_hedge = next_wave < len(waves) and need_more()
+        try:
+            mr = ch.get(timeout=delay if can_hedge else None)
+        except queue.Empty:
+            # No progress for one hedge delay: the next wave goes out
+            # now; the straggler's post keeps running in its worker and
+            # its response is still consumed if it arrives in time.
+            w = waves[next_wave]
+            launch(offsets[next_wave], w)
+            hedged_ids.update(id(p) for p in w)
+            stats["hedged"] += len(w)
+            metrics.incr(
+                "transport.hedge.sent", len(w), labels={"cmd": name}
+            )
+            outstanding += len(w)
+            next_wave += 1
+            continue
+        outstanding -= 1
+        if id(mr.peer) in hedged_ids:
+            answered_hedged += 1
+        if (cb is not None and cb(mr)) or not need_more():
+            break  # satisfied: stragglers finish in their workers
+    wasted = stats["hedged"] - answered_hedged
+    if wasted > 0:
+        stats["wasted"] = wasted
+        metrics.incr(
+            "transport.hedge.wasted", wasted, labels={"cmd": name}
+        )
+    return stats
 
 
 def _post_one(tr, name, peer, addr, cipher, nonce, payload, ch) -> None:

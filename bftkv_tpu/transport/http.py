@@ -198,9 +198,14 @@ class TrHTTP:
         path = parts.path
         cmd_name = addr.rsplit("/", 1)[-1]
         body = msg or b""
+        # The adaptive per-peer deadline (transport.current_deadline)
+        # replaces the one fixed response timeout when the fan-out
+        # layer computed one for this peer; the fixed rpc_timeout stays
+        # the ceiling either way.
+        timeout = tp.current_deadline(self.rpc_timeout)
         while True:
             try:
-                conn, reused = self._pool.acquire(host, port, self.rpc_timeout)
+                conn, reused = self._pool.acquire(host, port, timeout)
             except Exception as e:
                 if _is_timeout(e):
                     raise tp.ERR_RPC_TIMEOUT from None

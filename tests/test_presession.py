@@ -116,6 +116,55 @@ def test_pump_reseals_cold_peer():
         c.stop()
 
 
+def test_pump_skips_open_breaker_peer_until_half_open():
+    """A downed peer whose circuit breaker is OPEN stops consuming pump
+    work (crypto.session.reseal_skipped); once the breaker's open
+    window lapses (half-open), the pump re-seals it again — without
+    ever consuming the breaker's one half-open probe slot itself."""
+    import time as _time
+
+    from bftkv_tpu import transport as tp
+
+    c = start_cluster(4, 1, 4, bits=BITS)
+    cl = c.clients[0]
+    was_enabled = tp.peer_health.enabled
+    try:
+        cl.write(b"skip/x", b"v")  # establishes sessions + warm set
+        cl.drain_tails()
+        cl._presession.warm_once()  # seal every staged-wave leftover
+        msg = cl.tr.security.message
+        victim = next(iter(cl._presession._warm_peers.values()))
+        msg.invalidate(victim.id)
+        assert not msg.has_session(victim.id)
+
+        tp.peer_health.enabled = True
+        tp.peer_health.reset()
+        for _ in range(tp.peer_health.threshold):
+            tp.peer_health.fail(victim.address)
+        assert tp.peer_health.is_open(victim.address)
+
+        before = metrics.snapshot().get("crypto.session.reseal_skipped", 0)
+        assert cl._presession.warm_once() == 0
+        assert not msg.has_session(victim.id)  # no pump work burned
+        assert (
+            metrics.snapshot().get("crypto.session.reseal_skipped", 0)
+            == before + 1
+        )
+        # is_open never consumed the half-open probe: force the open
+        # window to lapse and the pump immediately re-seals.
+        with tp.peer_health._lock:
+            tp.peer_health._states[victim.address][1] = (
+                _time.monotonic() - 1.0
+            )
+        assert not tp.peer_health.is_open(victim.address)
+        assert cl._presession.warm_once() >= 1
+        assert msg.has_session(victim.id)
+    finally:
+        tp.peer_health.enabled = was_enabled
+        tp.peer_health.reset()
+        c.stop()
+
+
 def test_restarted_peer_costs_one_reseal_not_group_bootstrap():
     """The stale-session edge: a replica restart invalidates only ITS
     pairwise session.  The next write's grouped sealing keeps every

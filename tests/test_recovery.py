@@ -1,0 +1,318 @@
+"""Self-healing recovery plane (DESIGN.md §13): the pending-residue
+repair daemon and the adaptive per-peer deadline tracker.
+
+The headline scenario is the client-killed-mid-tail orphan: a writer
+that crashes after the 2f+1 commit but before its async back-fill
+leaves commit-pending residue plane-wide.  The repair daemon must
+certify it fleet-wide with ZERO reads issued; never-certifiable
+planted residue must be demoted with exactly one tail_starved anomaly.
+"""
+
+from __future__ import annotations
+
+import time
+
+import pytest
+
+from bftkv_tpu import packet as pkt
+from bftkv_tpu import quorum as qm
+from bftkv_tpu import transport as tp
+from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu.sync import SyncDaemon
+from bftkv_tpu.transport.latency import PeerLatency
+
+from cluster_utils import start_cluster
+
+BITS = 1024
+
+
+@pytest.fixture(scope="module")
+def cluster():
+    c = start_cluster(4, 1, 4, bits=BITS)
+    # Warm the write path so orphan scenarios measure repair, not setup.
+    c.clients[0].write(b"recovery/warmup", b"w")
+    c.clients[0].drain_tails()
+    yield c
+    c.stop()
+
+
+def _orphan_write(monkeypatch, cl, var: bytes, val: bytes) -> None:
+    """A write killed between the 2f+1 commit and its back-fill tail:
+    the commit round runs in full, the tail (mint + verify + coalesced
+    back-fill) never does — exactly a writer crash at that instant."""
+    monkeypatch.setattr(cl, "_ws_finish", lambda *a, **k: None)
+    cl.write(var, val)
+    monkeypatch.undo()
+
+
+def _read_counters(snap: dict) -> int:
+    return sum(
+        v
+        for k, v in snap.items()
+        if k.startswith("server.read.count")
+        or k.startswith("server.batch_read.count")
+    )
+
+
+def test_orphan_repair_certifies_fleet_wide_without_reads(
+    cluster, monkeypatch
+):
+    """Checker invariant 3's premise, restored by the daemon alone:
+    after the repair pass every replica holds the record with a
+    VERIFYING collective signature — and not one READ was issued."""
+    cl = cluster.clients[0]
+    var, val = b"recovery/orphan", b"orphaned-value"
+    _orphan_write(monkeypatch, cl, var, val)
+    cl.drain_tails()
+
+    # Every replica that admitted the round holds commit-pending
+    # residue; none holds a certified version.
+    pending = 0
+    for srv in cluster.all_servers:
+        try:
+            p = pkt.parse(srv.storage.read(var, 0))
+        except Exception:
+            continue
+        assert not (p.ss is not None and p.ss.completed)
+        pending += 1
+    assert pending >= 3  # at least the committing 2f+1 prefix persisted
+
+    reads_before = _read_counters(metrics.snapshot())
+    cert_before = metrics.snapshot().get("sync.repair.certified", 0)
+
+    # One replica's daemon repairs (grace window ignored via
+    # repair_once); its SIGN round + plane-wide back-fill must certify
+    # EVERYONE — the other daemons find nothing left to do.
+    daemon = SyncDaemon(cluster.storage_servers[0], interval=999)
+    stats = daemon.repair_once()
+    assert stats["certified"] >= 1
+    assert stats["demoted"] == 0
+
+    qa = qm.choose_quorum_for(cl.qs, var, qm.AUTH)
+    for srv in cluster.all_servers:
+        raw = srv.storage.read(var, 0)
+        p = pkt.parse(raw)
+        assert p.ss is not None and p.ss.completed, (
+            f"{srv.self_node.name} still holds uncertified residue"
+        )
+        srv.crypt.collective.verify(
+            pkt.tbss(raw), p.ss, qa, srv.crypt.keyring
+        )
+    assert cl.read(var) == val
+
+    assert metrics.snapshot().get("sync.repair.certified", 0) >= (
+        cert_before + 1
+    )
+    # Zero reads issued by the repair itself: the read counters moved
+    # only by the single verification read() above.
+    assert _read_counters(metrics.snapshot()) - reads_before <= len(
+        cluster.all_servers
+    )
+
+    # The plane is settled for this variable: a second pass on the same
+    # daemon has nothing left to repair and nothing to demote.
+    again = daemon.repair_once()
+    assert again["certified"] == 0 and again["demoted"] == 0
+
+
+def test_repair_respects_grace_window(cluster, monkeypatch):
+    """Residue younger than BFTKV_REPAIR_AFTER is presumed to be a live
+    write's tail and left alone; it repairs once the window passes."""
+    cl = cluster.clients[0]
+    var = b"recovery/grace"
+    _orphan_write(monkeypatch, cl, var, b"young")
+    cl.drain_tails()
+
+    srv = cluster.storage_servers[1]
+    daemon = SyncDaemon(srv, interval=999, repair_after=3600.0)
+    stats = daemon.repair_round()
+    assert stats["certified"] == 0 and stats["waiting"] >= 1
+    p = pkt.parse(srv.storage.read(var, 0))
+    assert not p.ss.completed  # untouched inside the grace window
+
+    daemon.repair_after = 0.0
+    time.sleep(0.01)
+    stats = daemon.repair_round()
+    assert stats["certified"] == 1
+    p = pkt.parse(srv.storage.read(var, 0))
+    assert p.ss is not None and p.ss.completed
+
+
+def test_uncertifiable_residue_demoted_with_one_anomaly(cluster):
+    """A planted record no quorum will ever endorse (its writer
+    signature does not verify) is demoted — once — and surfaces as
+    exactly one tail_starved anomaly in the fleet feed."""
+    from bftkv_tpu import trace as trmod
+    from bftkv_tpu.obs import FleetCollector
+
+    cl = cluster.clients[0]
+    srv = cluster.storage_servers[2]
+    var = b"recovery/poison"
+    # Valid signature STRUCTURE over the wrong preimage: every honest
+    # replica's writer-signature check refuses to sign this record.
+    sig = cl.crypt.signer.issue(pkt.serialize(var, b"other", 1, nfields=3))
+    residue = pkt.serialize(
+        var,
+        b"planted",
+        1,
+        sig,
+        pkt.SignaturePacket(
+            type=pkt.SIGNATURE_TYPE_NATIVE, version=1, completed=False,
+            data=None,
+        ),
+    )
+    srv.storage.write(var, 1, residue)
+
+    collector = FleetCollector([], local_metrics=metrics)
+    collector.scrape_once()  # counter-delta baseline
+    seq0 = max((a["seq"] for a in collector.anomalies()), default=0)
+
+    def fresh_starved():
+        return [
+            a
+            for a in collector.anomalies(since_seq=seq0)
+            if a["kind"] == "tail_starved"
+        ]
+
+    daemon = SyncDaemon(srv, interval=999)
+    stats = daemon.repair_once()
+    assert stats["demoted"] == 1 and stats["certified"] == 0
+
+    collector.scrape_once()
+    starved = fresh_starved()
+    assert len(starved) == 1
+    assert "sync.repair.demoted" in starved[0]["detail"]
+
+    # Demotion is remembered: no retry loop, no second anomaly.
+    stats = daemon.repair_once()
+    assert stats["demoted"] == 0 and stats["certified"] == 0
+    collector.scrape_once()
+    assert len(fresh_starved()) == 1
+
+
+def test_outage_retries_instead_of_demoting(cluster, monkeypatch):
+    """A SIGN round that fails on transport errors alone (partition,
+    timeouts) is an OUTAGE, not a verdict: the residue is retried next
+    round, never demoted, and no tail_starved anomaly fires."""
+    from bftkv_tpu.faults import failpoint as fp
+
+    cl = cluster.clients[0]
+    var = b"recovery/outage"
+    _orphan_write(monkeypatch, cl, var, b"survives-partition")
+    cl.drain_tails()
+
+    # A replica inside the staged commit wave (the interleaved prefix
+    # contacts the first storage seats), so it holds the residue.
+    srv = cluster.storage_servers[1]
+    daemon = SyncDaemon(srv, interval=999)
+    demoted_before = metrics.snapshot().get("sync.repair.demoted", 0)
+    fp.arm(9)
+    fp.registry.add(
+        "transport.send", "drop", match={"cmd": "sign"}, rule_id="cut"
+    )
+    try:
+        stats = daemon.repair_once()
+    finally:
+        fp.disarm()
+    assert stats["retrying"] >= 1 and stats["demoted"] == 0
+    assert (
+        metrics.snapshot().get("sync.repair.demoted", 0) == demoted_before
+    )
+    # The partition heals: the same daemon certifies on the next pass.
+    stats = daemon.repair_once()
+    assert stats["certified"] >= 1 and stats["demoted"] == 0
+    p = pkt.parse(srv.storage.read(var, 0))
+    assert p.ss is not None and p.ss.completed
+
+
+def test_repair_skips_protected_and_certified(cluster):
+    """pending_variables: certified records, hidden-prefix state and
+    TPA-protected records never enter the repair scan."""
+    srv = cluster.storage_servers[0]
+    pending, _cursor = srv.pending_variables()
+    for variable, t, _raw, p in pending:
+        assert not (p.ss is not None and p.ss.completed)
+        assert p.auth is None
+        assert not variable.startswith(b"!!!secret!!!")
+
+
+def test_pending_scan_windowed_cursor(cluster):
+    """The repair scan is windowed: a tiny scan_window pages through
+    the keyspace with a resumable cursor instead of parsing the whole
+    store per call."""
+    srv = cluster.storage_servers[0]
+    all_keys = sorted(srv.storage.keys())
+    seen: list[bytes] = []
+    cursor = None
+    for _ in range(len(all_keys) + 1):
+        _pending, cursor = srv.pending_variables(
+            after=cursor, scan_window=2
+        )
+        if cursor is None:
+            break
+        seen.append(cursor)
+    assert cursor is None  # the cycle terminates
+    assert seen == sorted(seen)  # strictly forward progress
+
+
+# -- adaptive per-peer deadlines (transport/latency.py) ---------------------
+
+
+def test_adaptive_deadline_tracks_peer_history():
+    pl = PeerLatency()
+    pl.floor = 0.05
+    addr = "loop://fast"
+    for _ in range(8):
+        pl.record(addr, 0.01)
+    # 8 x p99 + slack, far under the fixed 10 s worst case.
+    dl = pl.deadline(addr, 10.0)
+    assert 0.05 <= dl <= 0.5
+    # An unknown peer keeps the configured worst case.
+    assert pl.deadline("loop://stranger", 10.0) == 10.0
+    # The deadline is exported as a gauge.
+    snap = metrics.snapshot()
+    assert any(
+        k.startswith("transport.peer.deadline_ms") for k in snap
+    )
+
+
+def test_adaptive_deadline_disabled_env(monkeypatch):
+    monkeypatch.setenv("BFTKV_ADAPTIVE_TIMEOUT", "off")
+    pl = PeerLatency()
+    for _ in range(8):
+        pl.record("loop://x", 0.01)
+    assert pl.deadline("loop://x", 10.0) == 10.0
+
+
+def test_gray_flag_trips_and_recovers():
+    pl = PeerLatency()
+    addr = "loop://grayish"
+    before = metrics.snapshot().get(
+        "transport.peer.slow{peer=grayish}", 0
+    )
+    for _ in range(6):
+        pl.record(addr, 0.02)
+    assert not pl.is_gray(addr)
+    pl.record(addr, 1.5)  # way past 3 x p50 and the absolute guard
+    assert pl.is_gray(addr)
+    assert (
+        metrics.snapshot().get("transport.peer.slow{peer=grayish}", 0)
+        == before + 1
+    )
+    # A genuinely fast answer clears the flag early.
+    pl.record(addr, 0.02)
+    assert not pl.is_gray(addr)
+
+
+def test_hedge_delay_bounded():
+    pl = PeerLatency()
+    assert pl.hedge_delay(["loop://nobody"]) == pl.hedge_min
+    for _ in range(8):
+        pl.record("loop://slowish", 5.0)
+    assert pl.hedge_delay(["loop://slowish"]) == pl.hedge_cap
+
+
+def test_timeout_records_as_gray_sample():
+    pl = PeerLatency()
+    pl.record("loop://dead", 1.0, timeout=True)
+    assert pl.is_gray("loop://dead")

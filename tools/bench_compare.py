@@ -38,7 +38,14 @@ import argparse
 import json
 import sys
 
-__all__ = ["compare", "extract_sections", "main"]
+__all__ = ["compare", "extract_sections", "main", "REPORT_ONLY"]
+
+#: Sections printed but never gated.  cluster_4_gray is a fault-
+#: injection section (one member deliberately delayed): its absolute
+#: rate swings with the injected delay and the hedging knobs under
+#: test, so for its first landing it reports — the gray acceptance
+#: criterion lives in tests/test_hedge.py, not here.
+REPORT_ONLY = {"cluster_4_gray"}
 
 
 def _backend_class(status: str) -> str:
@@ -116,6 +123,11 @@ def compare(
         if prefix and not name.startswith(prefix):
             continue
         (sa, va, pa), (sb, vb, pb) = a[name], b[name]
+        if name in REPORT_ONLY:
+            lines.append(
+                f"  {name}: {va} -> {vb}  (report-only, not gated)"
+            )
+            continue
         if va is None or vb is None:
             lines.append(f"  {name}: no shared number "
                          f"({sa}:{va} -> {sb}:{vb}), skipped")
