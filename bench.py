@@ -32,6 +32,10 @@ BENCH_ZIPF=S (or ``--zipf S``): zipf-skewed key popularity for the
 cluster sections — writers draw from one shared hot-key distribution
 (exponent S, e.g. 1.1) instead of disjoint uniform keys; same-key
 write races then surface as counted ``write_conflicts``, not errors.
+BENCH_OPEN_LOOP=RATE (or ``--open-loop RATE``): cluster writers and
+the gateway readers run open-loop at RATE ops/s — latency measured
+from each op's scheduled arrival (coordinated-omission-corrected)
+instead of throughput at saturation.
 """
 
 from __future__ import annotations
@@ -588,6 +592,46 @@ def _is_write_conflict(e: Exception) -> bool:
     )
 
 
+class _OpenLoop:
+    """Open-loop arrival schedule for one worker pool (ROADMAP item 5,
+    first slice): ``rate`` ops/s spread evenly over ``workers`` workers.
+    Worker ``ci``'s ``k``-th op is DUE at ``t0 + (k·workers + ci)/rate``
+    — the worker sleeps until then and the recorded latency runs from
+    the DUE time, so a backed-up system shows its queueing delay
+    (coordinated-omission-corrected) instead of quietly slowing the
+    offered load the way a closed loop does."""
+
+    def __init__(self, rate: float, workers: int):
+        self.rate = rate
+        self.workers = workers
+        self.t0 = time.perf_counter()
+
+    def due(self, ci: int, k: int) -> float:
+        return self.t0 + (k * self.workers + ci) / self.rate
+
+    def wait(self, ci: int, k: int) -> float:
+        """Sleep until op (ci, k) is due; returns the due time."""
+        due = self.due(ci, k)
+        delay = due - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        return due
+
+
+def _ol_stats(lats: list[float], rate: float, elapsed: float, n: int) -> dict:
+    lats = sorted(lats)
+    return {
+        "offered_rate_per_sec": rate,
+        "achieved_rate_per_sec": round(n / elapsed, 2) if elapsed else 0,
+        "p50_offered_s": round(lats[len(lats) // 2], 4) if lats else 0,
+        "p99_offered_s": round(
+            lats[min(len(lats) - 1, int(len(lats) * 0.99))], 4
+        )
+        if lats
+        else 0,
+    }
+
+
 def bench_cluster(
     n_servers: int,
     n_rw: int,
@@ -601,6 +645,7 @@ def bench_cluster(
     transport: str = "loop",
     alg: str = "rsa",
     zipf: float = 0.0,
+    open_loop: float = 0.0,
 ) -> dict:
     """Signed writes/sec (+ optional read mix) through a live in-process
     cluster with the verify dispatcher installed.  ``zipf > 0`` draws
@@ -669,6 +714,8 @@ def bench_cluster(
         errors: list = []
         reads_by_thread = [0] * writers
         conflicts_by_thread = [0] * writers
+        ol = _OpenLoop(open_loop, writers) if open_loop > 0 else None
+        ol_lats: list[list[float]] = [[] for _ in range(writers)]
         zipf_probs = (
             _zipf_probs(max(writers * writes_per_writer, 16), zipf)
             if zipf > 0
@@ -686,8 +733,11 @@ def bench_cluster(
                         var = b"bench/%d/%d" % (ci, i)
                     else:
                         var = _zipf_key(rng, ci, zipf_probs)
+                    due = ol.wait(ci, i) if ol is not None else None
                     try:
                         client.write(var, value)
+                        if due is not None:
+                            ol_lats[ci].append(time.perf_counter() - due)
                     except Exception as e:
                         if zipf_probs is None or not _is_write_conflict(e):
                             raise
@@ -786,6 +836,16 @@ def bench_cluster(
         if zipf > 0:
             res["zipf_s"] = zipf
             res["write_conflicts"] = sum(conflicts_by_thread)
+        if ol is not None:
+            # Latency AT a target offered load, not throughput at
+            # saturation: p50/p99 measured from each op's scheduled
+            # arrival (queueing delay included).
+            res["open_loop"] = _ol_stats(
+                [x for l in ol_lats for x in l],
+                open_loop,
+                elapsed,
+                total_writes,
+            )
         res["round_p50_s"] = _round_breakdown(trace_cur0)
         res.update(_hot_loop_metrics(snap))
         return res
@@ -812,8 +872,9 @@ def bench_cluster_gray(
     4-node loopback cluster delayed ``delay_s`` per inbound post (a
     slow-but-ALIVE peer, ~5-10x a loopback p99) while writers run —
     hedging + health-aware staging ON vs OFF, plus the recovery
-    plane's repair counters.  The headline rate is the hedged run;
-    ``tools/bench_compare.py`` treats this section as report-only."""
+    plane's repair counters.  The headline rate is the hedged run,
+    and ``gray_slowdown_hedged`` is GATED by tools/bench_compare.py
+    (absolute ≤2x bound) on every committed round."""
     from bftkv_tpu import transport as tptr
     from bftkv_tpu.faults import failpoint as fp
     from bftkv_tpu.metrics import registry as metrics
@@ -949,6 +1010,215 @@ def bench_cluster_gray(
         dispatch.uninstall_all()
         for s in servers:
             s.tr.stop()
+
+
+def bench_cluster_gateway(
+    n_servers: int = 4,
+    n_rw: int = 4,
+    n_gateways: int = 2,
+    readers: int = 8,
+    reads_per_reader: int = 40,
+    writers: int = 4,
+    writes_per_writer: int = 5,
+    *,
+    value_size: int = 512,
+    hot_keys: int = 16,
+    bits: int = 1024,
+    open_loop: float = 0.0,
+) -> dict:
+    """Edge gateway tier proof (ROADMAP item 1, DESIGN.md §14): the
+    same reader pool drives a hot keyset DIRECT (full quorum fan-out
+    per read) and then THROUGH N stacked gateways (one front-door post;
+    certified read-through cache) — the headline is the gateway
+    aggregate read rate with its speedup and steady-state hit rate.
+    Writes run both ways too: concurrent front-door writes coalesce
+    into shared rounds and must be no worse than the direct path.
+    ``open_loop > 0`` additionally measures gateway read latency at
+    that offered load (ops/s) instead of at saturation."""
+    from bftkv_tpu.metrics import registry as metrics
+    from bftkv_tpu.ops import dispatch
+    from bftkv_tpu.storage.memkv import MemStorage
+    from tests.cluster_utils import start_cluster
+
+    t_setup = time.perf_counter()
+    cluster = start_cluster(
+        n_servers,
+        max(readers, writers),
+        n_rw,
+        bits=bits,
+        storage_factory=MemStorage,
+        n_gateways=n_gateways,
+    )
+    setup_s = time.perf_counter() - t_setup
+    try:
+        dispatch.install(dispatch.VerifyDispatcher(max_batch=256))
+        dispatch.install_signer(dispatch.SignDispatcher(max_batch=128))
+        value = os.urandom(value_size)
+        clients = cluster.clients
+        gw_clients = [
+            cluster.gateway_client(i) for i in range(readers)
+        ]
+        keys = [b"gwbench/hot/%d" % i for i in range(hot_keys)]
+        # Seed the hot keyset through the front door (the gateway tier
+        # owns it under TOFU) and warm every reader's sessions + the
+        # verify memo on both paths.
+        for k in keys:
+            gw_clients[0].write(k, value)
+        for ci in range(readers):
+            clients[ci].read(keys[ci % hot_keys])
+            gw_clients[ci].read(keys[ci % hot_keys])
+        for c in clients[:writers]:
+            if hasattr(c, "drain_tails"):
+                c.drain_tails()
+        for gw in cluster.gateways:
+            gw.client.drain_tails()
+
+        def read_phase(fn) -> tuple[float, float, list[float]]:
+            """(elapsed, reads/s, per-op latencies) over the pool."""
+            errors: list = []
+            lats: list[list[float]] = [[] for _ in range(readers)]
+            ol = (
+                _OpenLoop(open_loop, readers) if open_loop > 0 else None
+            )
+
+            def run(ci: int) -> None:
+                rng = np.random.default_rng(ci)
+                try:
+                    for i in range(reads_per_reader):
+                        k = keys[int(rng.integers(0, hot_keys))]
+                        due = (
+                            ol.wait(ci, i) if ol is not None else
+                            time.perf_counter()
+                        )
+                        got = fn(ci, k)
+                        lats[ci].append(time.perf_counter() - due)
+                        assert got == value, "read-back mismatch"
+                except Exception as e:
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=run, args=(ci,), daemon=True)
+                for ci in range(readers)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            n = readers * reads_per_reader
+            return elapsed, n / elapsed, sorted(
+                x for l in lats for x in l
+            )
+
+        # Direct: the classic quorum read every client pays today.
+        el_d, direct_rate, lats_d = read_phase(
+            lambda ci, k: clients[ci].read(k)
+        )
+        # Gateway: one front-door post, served from the certified
+        # cache (client-side re-verification stays ON — that cost is
+        # part of the honest number).
+        metrics.reset()
+        el_g, gw_rate, lats_g = read_phase(
+            lambda ci, k: gw_clients[ci].read(k)
+        )
+        snap = metrics.snapshot()
+        hits = snap.get("gateway.cache.hits", 0)
+        misses = snap.get("gateway.cache.misses", 0)
+        hit_rate = hits / (hits + misses) if hits + misses else 0.0
+
+        # Writes, both ways, on disjoint keyspaces (TOFU owns a
+        # variable per writing identity).  Concurrent front-door
+        # writers meet in the coalescer, so distinct-variable bursts
+        # batch per shard (write_many) — same-variable collapse is
+        # covered by tests/test_gateway.py; here the apples-to-apples
+        # workload is distinct keys on both paths.
+        def write_phase(fn, tag: bytes) -> float:
+            errors: list = []
+
+            def run(ci: int) -> None:
+                try:
+                    for i in range(writes_per_writer):
+                        fn(ci, b"gwbench/w/%s/%d/%d" % (tag, ci, i))
+                except Exception as e:
+                    errors.append(e)
+
+            threads = [
+                threading.Thread(target=run, args=(ci,), daemon=True)
+                for ci in range(writers)
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            elapsed = time.perf_counter() - t0
+            if errors:
+                raise errors[0]
+            return writers * writes_per_writer / elapsed
+
+        direct_wrate = write_phase(
+            lambda ci, k: clients[ci].write(k, value), b"direct"
+        )
+        w0 = metrics.snapshot()
+        gw_wrate = write_phase(
+            lambda ci, k: gw_clients[ci].write(k, value), b"gw"
+        )
+        w1 = metrics.snapshot()
+        for c in clients[:writers]:
+            c.drain_tails()
+        for gw in cluster.gateways:
+            gw.client.drain_tails()
+
+        res = {
+            # Headline FIRST: the compact record keys off the first
+            # *_per_sec field.
+            "reads_per_sec": round(gw_rate, 2),
+            "direct_reads_per_sec": round(direct_rate, 2),
+            "speedup_vs_direct": round(gw_rate / direct_rate, 2)
+            if direct_rate
+            else 0.0,
+            "cache_hit_rate": round(hit_rate, 4),
+            "cache_hits": hits,
+            "cache_misses": misses,
+            "read_p50_s": round(lats_g[len(lats_g) // 2], 5),
+            "direct_read_p50_s": round(lats_d[len(lats_d) // 2], 5),
+            "writes_per_sec_gateway": round(gw_wrate, 2),
+            "writes_per_sec_direct": round(direct_wrate, 2),
+            "write_ratio_vs_direct": round(gw_wrate / direct_wrate, 2)
+            if direct_wrate
+            else 0.0,
+            "writes_coalesced": w1.get("gateway.write.coalesced", 0)
+            - w0.get("gateway.write.coalesced", 0),
+            "write_batched_rounds": w1.get(
+                "gateway.write.batched_rounds", 0
+            )
+            - w0.get("gateway.write.batched_rounds", 0),
+            "gateways": n_gateways,
+            "replicas": n_servers + n_rw,
+            "readers": readers,
+            "reads": readers * reads_per_reader,
+            "writers": writers,
+            "value_bytes": value_size,
+            "bits": bits,
+            "shed": sum(
+                v
+                for k, v in w1.items()
+                if k.startswith("gateway.shed")
+            ),
+            "verify_fail": w1.get("gateway.cache.verify_fail", 0),
+            "setup_s": round(setup_s, 1),
+        }
+        if open_loop > 0:
+            res["open_loop"] = _ol_stats(
+                lats_g, open_loop, el_g, readers * reads_per_reader
+            )
+        return res
+    finally:
+        dispatch.uninstall_all()
+        cluster.stop()
 
 
 def bench_cluster_batch(
@@ -1453,6 +1723,7 @@ SECTION_NAMES = {
     "bmix64ec": "cluster_64_batched_mix_ec",
     "cshards": "cluster_shards",
     "c4gray": "cluster_4_gray",
+    "cgw": "cluster_gateway",
     "thr": "threshold_5_9",
     "tally": "revoke_tally_256",
 }
@@ -1461,8 +1732,9 @@ SECTION_NAMES = {
 # unreachable AND no cached TPU measurement exists (last resort).
 # cluster_shards is a self-relative scaling ratio, meaningful on any
 # backend; cluster_4_gray is hedged-vs-unhedged on the same box, also
-# self-relative.
-CPU_OK = {"tally", "c4", "cshards", "c4gray"}
+# self-relative; cluster_gateway is gateway-vs-direct on the same box,
+# likewise self-relative.
+CPU_OK = {"tally", "c4", "cshards", "c4gray", "cgw"}
 
 # Per-section subprocess timeouts (seconds).  The flapping tunnel makes
 # a hung section indistinguishable from a slow one until the timeout
@@ -1474,6 +1746,7 @@ TOKEN_TIMEOUT = {
     "kernel": 600, "modexp": 600, "tally": 600,
     "rns": 900, "sign": 900, "ec": 900, "thr": 900,
     "c4": 900, "c4http": 900, "c4ec": 900, "c16": 900, "c4gray": 900,
+    "cgw": 900,
     "b16": 1200, "b64": 1500, "bmix64": 1500, "bmix64ec": 1500,
     "c64": 1500, "mix64": 1500, "cshards": 1500,
 }
@@ -1504,6 +1777,7 @@ def _section_spec(token: str):
     writes = int(os.environ.get("BENCH_WRITES", "4" if FAST else "16"))
     batch_size = int(os.environ.get("BENCH_BATCH", "256" if FAST else "1024"))
     zipf = float(os.environ.get("BENCH_ZIPF", "0") or 0)
+    open_loop = float(os.environ.get("BENCH_OPEN_LOOP", "0") or 0)
     specs = {
         "kernel": lambda: bench_kernel_verify(batches),
         "rns": lambda: bench_kernel_rns(
@@ -1521,7 +1795,7 @@ def _section_spec(token: str):
         ),
         "c4": lambda: bench_cluster(
             4, 4, writers, writes, storage="plain", dispatch_batch=256,
-            zipf=zipf,
+            zipf=zipf, open_loop=open_loop,
         ),
         "c4http": lambda: bench_cluster(
             4, 4, writers, writes, storage="mem", dispatch_batch=256,
@@ -1560,6 +1834,16 @@ def _section_spec(token: str):
         "c4gray": lambda: bench_cluster_gray(
             writers=4 if FAST else 8,
             writes_per_writer=4 if FAST else 10,
+        ),
+        # Edge gateway tier (ROADMAP item 1): N stacked gateways in
+        # front of the quorums — certified-cache read throughput vs
+        # direct quorum reads, coalesced front-door writes vs direct.
+        "cgw": lambda: bench_cluster_gateway(
+            readers=4 if FAST else 8,
+            reads_per_reader=10 if FAST else 40,
+            writers=2 if FAST else 4,
+            writes_per_writer=3 if FAST else 5,
+            open_loop=open_loop,
         ),
         "b16": lambda: bench_cluster_batch(
             16, 4, 2 if FAST else 4, batch_size, 1 if FAST else 2
@@ -1708,7 +1992,7 @@ def main() -> None:
 
     if FAST:
         default_configs = (
-            "rns,sign,b16,kernel,modexp,ec,c4,c16,cshards,c4gray,tally"
+            "rns,sign,b16,kernel,modexp,ec,c4,c16,cshards,c4gray,cgw,tally"
         )
     else:
         # Short kernel sections FIRST: the tunnel flaps and its live
@@ -1719,7 +2003,7 @@ def main() -> None:
         # BENCH_partial.json keeps whatever landed.
         default_configs = (
             "rns,sign,kernel,ec,modexp,b16,b64,bmix64,bmix64ec,"
-            "c4,c16,c64,c4http,c4ec,cshards,c4gray,thr,tally"
+            "c4,c16,c64,c4http,c4ec,cshards,c4gray,cgw,thr,tally"
         )
     configs = [t for t in _env_list("BENCH_CONFIGS", default_configs)
                if t in SECTION_NAMES]
@@ -1953,9 +2237,16 @@ def _compact_extra(extra: dict, configs: list, headline_from) -> dict:
         # Cluster sections additionally carry write p50 as a third
         # element, so the driver round records gate LATENCY regressions
         # too (tools/bench_compare.py; two-element records stay valid).
+        # The gray section carries its hedged slowdown ratio as a
+        # FOURTH element — bench_compare holds it under the absolute
+        # ≤2x acceptance bound.
         p50 = sec.get("write_p50_s")
+        gray = sec.get("gray_slowdown_hedged")
         if num is not None and isinstance(p50, (int, float)) and p50 > 0:
-            sections[name] = [status, num, p50]
+            if isinstance(gray, (int, float)) and gray > 0:
+                sections[name] = [status, num, p50, gray]
+            else:
+                sections[name] = [status, num, p50]
         elif num is not None:
             sections[name] = [status, num]
         else:
@@ -1980,6 +2271,13 @@ if __name__ == "__main__":
     if "--zipf" in sys.argv:
         i = sys.argv.index("--zipf")
         os.environ["BENCH_ZIPF"] = sys.argv[i + 1]
+        del sys.argv[i : i + 2]
+    # --open-loop RATE: cluster writers (and the gateway readers) run
+    # at a target offered load (ops/s) with coordinated-omission-
+    # corrected latency, instead of closed-loop at saturation.
+    if "--open-loop" in sys.argv:
+        i = sys.argv.index("--open-loop")
+        os.environ["BENCH_OPEN_LOOP"] = sys.argv[i + 1]
         del sys.argv[i : i + 2]
     if len(sys.argv) >= 5 and sys.argv[1] == "--run-section":
         _child_main(sys.argv[2], sys.argv[4])

@@ -78,6 +78,16 @@ def render(doc: dict) -> str:
                 f"trace={ex['trace_id']}"
                 + (f" peer={ex['peer']}" if "peer" in ex else "")
             )
+    for name, g in sorted((doc.get("gateways") or {}).items()):
+        mark = "·" if g["status"] == "up" else "✗"
+        hits, misses = g.get("hits", 0), g.get("misses", 0)
+        rate = hits / (hits + misses) if hits + misses else 0.0
+        lines.append(
+            f"  {mark} {name} [gateway] {g['status']} · "
+            f"cache {g.get('entries', 0)} entries, "
+            f"hit rate {rate:.0%} · shed {g.get('shed', 0)} · "
+            f"verify_fail {g.get('verify_fail', 0)}"
+        )
     for a in doc["anomalies"][-8:]:
         lines.append(
             f"anomaly #{a['seq']} {a['kind']} src={a['source']} "

@@ -29,6 +29,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--users", type=int, default=1)
     ap.add_argument("--unsigned-users", type=int, default=0,
                     help="trailing users without quorum certificates (TOFU)")
+    ap.add_argument("--gateways", type=int, default=0,
+                    help="edge gateway identities (gw01..): quorum-"
+                         "certified front-door principals sharing one "
+                         "TOFU uid, each with a dialable address "
+                         "(bftkv_tpu.cmd.run_gateway serves one)")
+    ap.add_argument("--gw-base-port", type=int, default=6201)
     ap.add_argument("--bits", type=int, default=2048)
     ap.add_argument("--alg", default="rsa", choices=["rsa", "p256", "mixed"],
                     help="identity-key algorithm: RSA-2048, ECDSA P-256, "
@@ -55,6 +61,8 @@ def main(argv: list[str] | None = None) -> int:
         server_trust_rw=args.server_trust_rw,
         alg=args.alg,
         n_shards=args.shards,
+        n_gateways=args.gateways,
+        gw_base_port=args.gw_base_port,
     )
     if args.shards > 1:
         groups = ", ".join(
@@ -69,7 +77,18 @@ def main(argv: list[str] | None = None) -> int:
             home, ident, uni.view_of(ident),
             local_trust=uni.local_trust_of(ident),
         )
-        print(f"{ident.name}: {home} ({ident.cert.address or 'client'})")
+        dial = uni.gateway_addrs.get(ident.name, "")
+        if dial:
+            # Gateway certs carry no address (they must stay out of
+            # the quorum planes); the dial address is deployment
+            # config, dropped beside the keys for run_gateway and for
+            # clients assembling their gateway list.
+            with open(os.path.join(home, "address"), "w") as f:
+                f.write(dial + "\n")
+        print(
+            f"{ident.name}: {home} "
+            f"({ident.cert.address or dial or 'client'})"
+        )
     return 0
 
 

@@ -29,10 +29,23 @@ def server_homes(keys_dir: str) -> list[str]:
         return out  # --shards generates into a fresh dir
     for name in sorted(os.listdir(keys_dir)):
         home = os.path.join(keys_dir, name)
-        if not os.path.isdir(home) or name.startswith("u"):
+        # u* are client homes, gw* are edge gateway homes (run by
+        # bftkv_tpu.cmd.run_gateway, not the replica daemon).
+        if not os.path.isdir(home) or name.startswith(("u", "gw")):
             continue
         out.append(home)
     return out
+
+
+def gateway_homes(keys_dir: str) -> list[str]:
+    if not os.path.isdir(keys_dir):
+        return []
+    return sorted(
+        os.path.join(keys_dir, name)
+        for name in os.listdir(keys_dir)
+        if name.startswith("gw")
+        and os.path.isdir(os.path.join(keys_dir, name))
+    )
 
 
 def spawn(
@@ -52,6 +65,8 @@ def spawn(
     chaos_seed: int | None = None,
     fleet: int = 0,
     fleet_interval: float = 2.0,
+    gw_homes: list[str] | None = None,
+    gw_sync_invalidate: float = 5.0,
     extra_env: dict | None = None,
 ) -> list[subprocess.Popen]:
     """``verify_sidecar``: "auto" spawns one shared sidecar process and
@@ -115,16 +130,35 @@ def spawn(
             # to run but the fleet does not fire faults in lockstep.
             cmd += ["--chaos-seed", str(chaos_seed + i)]
         procs.append(subprocess.Popen(cmd, env=env))
+    # Edge gateways ride after the replicas: their operator APIs take
+    # the next sequential ports, so the fleet collector scrapes the
+    # whole tier with one --count.
+    for j, home in enumerate(gw_homes or []):
+        cmd = [
+            sys.executable, "-m", "bftkv_tpu.cmd.run_gateway",
+            "--home", home,
+            "--sync-invalidate", str(gw_sync_invalidate),
+        ]
+        if api_base:
+            cmd += ["--api", f"{api_host}:{api_base + len(homes) + j}"]
+        if bind_host:
+            cmd += ["--bind-host", bind_host]
+        if rpc_timeout is not None:
+            cmd += ["--rpc-timeout", str(rpc_timeout)]
+        if fleet:
+            cmd += ["--fleet", f"http://127.0.0.1:{fleet}/fleet"]
+        procs.append(subprocess.Popen(cmd, env=env))
     if fleet:
         # The health plane rides alongside the fleet: one collector
-        # process scraping every daemon's /info + /metrics + /trace,
-        # serving the aggregate on /fleet (bftkv_tpu.obs).
+        # process scraping every daemon's (and gateway's) /info +
+        # /metrics + /trace, serving the aggregate on /fleet
+        # (bftkv_tpu.obs).
         procs.append(
             subprocess.Popen(
                 [
                     sys.executable, "-m", "bftkv_tpu.cmd.fleet",
                     "--api-base", str(api_base),
-                    "--count", str(len(homes)),
+                    "--count", str(len(homes) + len(gw_homes or [])),
                     "--api-host", api_host,
                     "--listen", f"127.0.0.1:{fleet}",
                     "--interval", str(fleet_interval),
@@ -197,6 +231,12 @@ def main(argv: list[str] | None = None) -> int:
                          "topology there first (4 servers + 4 rw per "
                          "shard, 1 user; the keyspace hash-routes "
                          "across the cliques) and then run it")
+    ap.add_argument("--gateways", type=int, default=0, metavar="N",
+                    help="run N edge gateways (cmd.run_gateway) from "
+                         "the gw* homes under --keys; their operator "
+                         "APIs take the ports after the daemons' and "
+                         "join the --fleet scrape.  The --shards "
+                         "quickstart generates the gw homes too")
     args = ap.parse_args(argv)
 
     if args.shards and not server_homes(args.keys):
@@ -209,6 +249,7 @@ def main(argv: list[str] | None = None) -> int:
         genkeys.main([
             "--out", args.keys, "--shards", str(args.shards),
             "--servers", "4", "--rw", "4", "--users", "1",
+            "--gateways", str(args.gateways),
         ])
 
     homes = server_homes(args.keys)
@@ -219,6 +260,12 @@ def main(argv: list[str] | None = None) -> int:
         print("--fleet needs --api-base (the collector scrapes the "
               "daemon APIs)", file=sys.stderr)
         return 1
+    gw_homes = gateway_homes(args.keys)[: args.gateways]
+    if args.gateways and len(gw_homes) < args.gateways:
+        print(f"--gateways {args.gateways} but only {len(gw_homes)} gw* "
+              f"homes under {args.keys} (genkeys --gateways)",
+              file=sys.stderr)
+        return 1
     procs = spawn(homes, args.db_root, storage=args.storage,
                   api_base=args.api_base, api_host=args.api_host,
                   bind_host=args.bind_host, client_home=args.client_home,
@@ -227,7 +274,8 @@ def main(argv: list[str] | None = None) -> int:
                   slow_trace=args.slow_trace,
                   rpc_timeout=args.rpc_timeout,
                   chaos_seed=args.chaos_seed,
-                  fleet=args.fleet, fleet_interval=args.fleet_interval)
+                  fleet=args.fleet, fleet_interval=args.fleet_interval,
+                  gw_homes=gw_homes)
     if args.fleet:
         print(f"run_cluster: fleet health @ http://127.0.0.1:{args.fleet}"
               "/fleet", flush=True)
@@ -235,7 +283,9 @@ def main(argv: list[str] | None = None) -> int:
     # whose clients fall back to local verification: its death must not
     # tear down the replica fleet, and it is not a "server".
     servers = [p for p in procs if "bftkv_tpu.cmd.bftkv" in p.args]
-    print(f"run_cluster: {len(servers)} servers up", flush=True)
+    print(f"run_cluster: {len(servers)} servers up"
+          + (f", {len(gw_homes)} gateways" if gw_homes else ""),
+          flush=True)
 
     stopping = False
 

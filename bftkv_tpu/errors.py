@@ -141,6 +141,14 @@ ERR_UNKNOWN_SESSION = new_error("unknown transport session")
 # Byzantine request dies in admission.
 ERR_WRONG_SHARD = new_error("wrong shard")
 
+# Edge gateway tier (this framework's addition, no reference analog):
+# the gateway's bounded admission queue is full — the caller should
+# back off or try another gateway; quorum state is untouched.
+ERR_GATEWAY_OVERLOADED = new_error("gateway overloaded")
+# A gateway fill whose collective signature failed verification against
+# the owner quorum: the record is never cached and never served.
+ERR_UNCERTIFIED_RECORD = new_error("uncertified record")
+
 # Storage errors (reference: storage/storage.go).
 ERR_NOT_FOUND = new_error("not found")
 

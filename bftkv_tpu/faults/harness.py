@@ -37,8 +37,26 @@ class ChaosCluster:
     servers: list[Server] = field(default_factory=list)  # quorum (a*)
     storage_servers: list[Server] = field(default_factory=list)  # rw*
     clients: list[Client] = field(default_factory=list)
+    gateways: list = field(default_factory=list)  # bftkv_tpu.gateway
+    gateway_addrs: dict[str, str] = field(default_factory=dict)
     _by_name: dict[str, Server] = field(default_factory=dict)
     _idents: dict[str, object] = field(default_factory=dict)
+
+    def gateway_names(self) -> list[str]:
+        return [gw.self_node.name for gw in self.gateways]
+
+    def gateway_client(self, i: int = 0, *, verify: bool = True):
+        from bftkv_tpu.gateway import GatewayClient, GatewayPeer
+
+        client = self.clients[i % len(self.clients)]
+        peers = [
+            GatewayPeer(
+                client.crypt.keyring.get(gw.self_node.get_self_id()),
+                self.gateway_addrs[gw.self_node.name],
+            )
+            for gw in self.gateways
+        ]
+        return GatewayClient(client, peers, verify=verify)
 
     @property
     def all_servers(self) -> list[Server]:
@@ -119,6 +137,8 @@ class ChaosCluster:
         return srv
 
     def stop(self) -> None:
+        for gw in self.gateways:
+            gw.stop()
         for s in self.all_servers:
             s.tr.stop()
 
@@ -133,10 +153,11 @@ def build_cluster(
     server_cls=Server,
     storage_factory=MemStorage,
     n_shards: int = 1,
+    n_gateways: int = 0,
 ) -> ChaosCluster:
     uni = topology.build_universe(
         n_servers, n_users, n_rw, scheme="loop", bits=bits,
-        n_shards=n_shards,
+        n_shards=n_shards, n_gateways=n_gateways,
     )
     net = LoopbackNet()
     recorder = recorder or HistoryRecorder()
@@ -159,4 +180,13 @@ def build_cluster(
         tr = TrLoopback(crypt, net)
         tr.link_id = ident.name  # clients are partitionable links too
         cluster.clients.append(Client(graph, qs, tr, crypt))
+    for ident in uni.gateways:
+        from bftkv_tpu.gateway import Gateway
+
+        graph, crypt, qs = topology.make_node(ident, uni.view_of(ident))
+        gw = Gateway(graph, qs, TrLoopback(crypt, net), crypt)
+        dial = uni.gateway_addrs[ident.name]
+        gw.start(dial.split("://", 1)[-1])
+        cluster.gateways.append(gw)
+        cluster.gateway_addrs[ident.name] = dial
     return cluster

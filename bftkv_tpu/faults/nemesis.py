@@ -80,6 +80,22 @@ class Nemesis:
         #: the f budget must never BLOCK commit — slower is fine,
         #: failed is a violation (the acceptance bar of DESIGN.md §13).
         self.gray_blocked: list[dict] = []
+        #: Front-door client when the cluster runs gateways: part of
+        #: every traffic window then, so gateway↔quorum faults (and
+        #: Byzantine fill attempts crossing the cache) manifest — and
+        #: every gateway-served read is RECORDED, so checker invariant
+        #: 3 (reads backed by a verifying collective signature) also
+        #: proves no uncertified value was ever served off the cache.
+        self._gwc = (
+            cluster.gateway_client(0)
+            if getattr(cluster, "gateways", None)
+            else None
+        )
+        self._gw_seq = 0
+        #: The most recently direct-written variable, tracked
+        #: explicitly — a lexicographic max over ``_written`` stops
+        #: being "newest" once window tags reach two digits.
+        self._last_direct_var: bytes | None = None
 
     # -- deterministic planning -------------------------------------------
 
@@ -124,6 +140,12 @@ class Nemesis:
             f_g = (len(names) - 1) // 3
             ws_pool += names[: 2 * f_g + 1]
         ws_pool = ws_pool or clique
+        # Edge gateways join the link-fault pools: a partition or delay
+        # on a gateway's links IS the gateway↔quorum fault class (its
+        # upstream fan-outs carry its own link id).
+        gw_names = sorted(
+            getattr(self.cluster, "gateway_names", lambda: [])()
+        )
         out = []
         for i in range(steps):
             kind = kinds[rng.randrange(len(kinds))]
@@ -134,6 +156,8 @@ class Nemesis:
                 # sit on the WRITE_SIGN critical path.
                 mode = ("all", "write_sign")[rng.randrange(2)]
                 pool = ws_pool if mode == "write_sign" else clique
+            elif kind in ("partition", "link_delay") and gw_names:
+                pool = targets + gw_names
             else:
                 pool = targets
             step = {"step": i, "kind": kind, "target": pool[rng.randrange(len(pool))]}
@@ -292,6 +316,8 @@ class Nemesis:
                 self._write_one(
                     cl, rec, cname, var, f"cover-{tag}".encode()
                 )
+        if self._gwc is not None:
+            self._gateway_traffic(tag)
         # str seeds hash via sha512 (deterministic); a tuple seed would
         # go through PYTHONHASHSEED-salted hash() and break replay.
         rng = random.Random(f"{self.seed}|{tag}")
@@ -318,11 +344,47 @@ class Nemesis:
                 rec.read_fail(cname, var, e)
                 self.failures["read"] += 1
 
+    def _gateway_traffic(self, tag: str) -> None:
+        """Per-window front-door traffic: one coalesced write + its
+        read-back (write-through cache), plus a quorum FILL of the
+        newest directly-written variable — so gateway↔quorum faults
+        and Byzantine fill attempts have certified-cache traffic to
+        cross in every window.  Failures count, never raise (under a
+        partitioned gateway failing is correct).  The gateway keyspace
+        (``chaos/gw/``) is disjoint from the direct clients' — TOFU
+        ownership pins a variable to one writing identity."""
+        rec = self.cluster.recorder
+        gwc = self._gwc
+        cname = "gw"
+        self._gw_seq += 1
+        var = f"chaos/gw/{tag}/{self._gw_seq}".encode()
+        val = f"gw-{tag}".encode()
+        try:
+            gwc.write(var, val)
+            rec.write_ok(cname, var, val)
+            self._written[var] = val
+        except Exception as e:
+            rec.write_fail(cname, var, e)
+            self.failures["write"] += 1
+        reads = [var]
+        if self._last_direct_var is not None:
+            # The newest direct var: a COLD quorum fill every window,
+            # crossing whatever fault (Byzantine replayer, cut link)
+            # is armed on the gateway↔quorum path.
+            reads.append(self._last_direct_var)
+        for rv in reads:
+            try:
+                rec.read_ok(cname, rv, gwc.read(rv))
+            except Exception as e:
+                rec.read_fail(cname, rv, e)
+                self.failures["read"] += 1
+
     def _write_one(self, cl, rec, cname: str, var: bytes, val: bytes) -> None:
         try:
             cl.write(var, val)
             rec.write_ok(cname, var, val)
             self._written[var] = val
+            self._last_direct_var = var
         except Exception as e:
             rec.write_fail(cname, var, e)
             self.failures["write"] += 1
@@ -382,6 +444,10 @@ class Nemesis:
             LocalSource(name, lambda n=name: self.cluster.server_named(n))
             for name in sorted(self.cluster._by_name)
         ]
+        for gw in getattr(self.cluster, "gateways", ()):
+            sources.append(
+                LocalSource(gw.self_node.name, lambda gw=gw: gw)
+            )
         return FleetCollector(
             sources,
             local_metrics=mreg,
@@ -589,8 +655,11 @@ class Nemesis:
             # Collapsed writes certify on an async tail; quiesce every
             # client's tails before convergence + the final safety
             # check, so "back-fill still in flight" can never be
-            # mistaken for a violation (or mask one).
-            for cl in self.cluster.clients:
+            # mistaken for a violation (or mask one).  Gateways write
+            # through their own internal clients — drain those too.
+            for cl in list(self.cluster.clients) + [
+                gw.client for gw in getattr(self.cluster, "gateways", ())
+            ]:
                 drain = getattr(cl, "drain_tails", None)
                 if drain is not None:
                     drain()
@@ -648,6 +717,13 @@ def main(argv: list[str] | None = None) -> int:
                     help="disjoint quorum cliques: faults then straddle "
                          "shard boundaries and the checker enforces the "
                          "cross-shard invariant")
+    ap.add_argument("--gateways", type=int, default=0,
+                    help="run N edge gateways in-process: every traffic "
+                         "window crosses the certified cache (write + "
+                         "read-back + cold fill), gateway links join "
+                         "the partition/link_delay target pool, and "
+                         "checker invariant 3 proves no uncertified "
+                         "value was ever served through the cache")
     ap.add_argument("--bits", type=int, default=1024)
     ap.add_argument("--dwell", type=float, default=0.0,
                     help="extra seconds to hold each fault window open")
@@ -669,7 +745,8 @@ def main(argv: list[str] | None = None) -> int:
         ap.error(f"--kinds must draw from {STEP_KINDS}")
 
     cluster = build_cluster(
-        args.servers, 1, args.rw, bits=args.bits, n_shards=args.shards
+        args.servers, 1, args.rw, bits=args.bits, n_shards=args.shards,
+        n_gateways=args.gateways,
     )
     try:
         report = Nemesis(cluster, seed=args.seed).run(

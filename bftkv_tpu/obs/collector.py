@@ -66,6 +66,13 @@ ANOMALY_COUNTERS = {
     # baseline (transport/latency.py) — alive for the prober, poison
     # for tail latency.  One event per gray episode, not per RPC.
     "transport.peer.slow": "gray_member",
+    # Edge gateway tier (bftkv_tpu/gateway).  Sustained shedding means
+    # the front door is turning clients away — capacity, not safety.
+    "gateway.shed": "gateway_shed",
+    # A fill (or write-through) whose collective signature failed
+    # verification against the owner quorum: someone fed the gateway a
+    # record the quorum never endorsed — the Byzantine-fill signal.
+    "gateway.cache.verify_fail": "gateway_poisoned_fill",
 }
 
 
@@ -293,7 +300,14 @@ class FleetCollector:
         t0 = time.perf_counter()
         info = None
         try:
-            if m.info_stale or not m.info:
+            # Gateways self-report their cache/shed stats on /info, so
+            # their seat document is live data, not topology — refetch
+            # every scrape instead of on the 30-scrape cadence.
+            if (
+                m.info_stale
+                or not m.info
+                or m.info.get("role") == "gateway"
+            ):
                 info = m.source.info() or {}
             if not getattr(m.source, "PROBE_BY_SCRAPE", False):
                 # In-process sources: the probe is the signal (their
@@ -444,15 +458,35 @@ class FleetCollector:
         seat is UNKNOWN, and binning it anywhere would let the shard
         it really belongs to report a full f-budget while one of its
         clique members is dark (health() surfaces these as
-        ``fleet.unseated`` instead)."""
+        ``fleet.unseated`` instead).  Gateways (``role: gateway``) are
+        deliberately NOT shard members: an edge box holds no quorum
+        seat, so it must never enter the clique f-budget math — they
+        report under ``health()["gateways"]`` instead."""
         shards: dict = {}
         for name, m in members.items():
-            if not m.info:
+            if not m.info or m.info.get("role") == "gateway":
                 continue
             sh = m.info.get("shard")
             sh = 0 if sh is None else sh
             shards.setdefault(sh, []).append((name, m))
         return shards
+
+    def _gateways(self, members: dict, now: float) -> dict:
+        """The edge tier's health rows: status + the gateway's own
+        cache/shed stats as self-reported on /info."""
+        out: dict = {}
+        for name, m in members.items():
+            if not m.info or m.info.get("role") != "gateway":
+                continue
+            out[name] = {
+                "status": m.status,
+                "scrape_s": round(m.scrape_s, 4),
+                "last_ok_age_s": round(now - m.last_ok, 1)
+                if m.last_ok
+                else None,
+                **(m.info.get("gateway") or {}),
+            }
+        return out
 
     def health(self) -> dict:
         shards_doc: dict = {}
@@ -542,6 +576,7 @@ class FleetCollector:
                 ),
             },
             "shards": shards_doc,
+            "gateways": self._gateways(all_members, now),
             "traces": {
                 **self.stitcher.summary(),
                 "recent": self.stitcher.traces(limit=10),
@@ -573,6 +608,17 @@ class FleetCollector:
         add("daemons", "gauge", "", str(doc["fleet"]["daemons"]))
         add("daemons_up", "gauge", "", str(doc["fleet"]["up"]))
         add("scrapes", "gauge", "", str(doc["scrapes"]))
+        gws = doc.get("gateways") or {}
+        if gws:
+            add("gateways", "gauge", "", str(len(gws)))
+            add("gateways_up", "gauge", "",
+                str(sum(1 for g in gws.values() if g["status"] == "up")))
+            for name, g in sorted(gws.items()):
+                lab = f'{{gateway="{name}"}}'
+                for field in ("hits", "misses", "shed", "verify_fail"):
+                    if isinstance(g.get(field), (int, float)):
+                        add(f"gateway_{field}", "gauge", lab,
+                            str(g[field]))
         add("traces_stitched", "gauge", "",
             str(doc["traces"]["stitched"]))
         add("anomalies_total", "counter", "", str(self._anomaly_seq))

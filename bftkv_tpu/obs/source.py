@@ -114,6 +114,15 @@ class LocalSource:
 
     def info(self) -> dict:
         srv = self.server_fn()
+        # A member that builds its own /info document (the edge
+        # Gateway: role=gateway + cache stats) is the authority — a
+        # seat document derived from its qs would misfile it as a
+        # quorum principal.
+        own = getattr(srv, "info", None)
+        if callable(own):
+            doc = dict(own())
+            doc.setdefault("name", self.name)
+            return doc
         g = srv.self_node
         out = {
             "name": self.name,

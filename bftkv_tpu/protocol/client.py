@@ -387,6 +387,22 @@ class Client(Protocol):
         #: (``apply_fleet_snapshot``); the client's own breaker/latency
         #: state works without them.
         self._health_hints: dict[str, str] = {}
+        #: Certified-record observer: ``fn(variable, record)`` called
+        #: with every record this client has VERIFIED a completed
+        #: collective signature for (the collapsed write's tail, the
+        #: batched write's phase-2 output).  The edge gateway hooks its
+        #: write-through cache fill here — invalidation rides the same
+        #: plane that delivers the certified bytes (DESIGN.md §14).
+        self.on_certified = None
+
+    def _notify_certified(self, variable: bytes, record: bytes) -> None:
+        cb = self.on_certified
+        if cb is None:
+            return
+        try:
+            cb(variable, record)
+        except Exception:
+            log.exception("on_certified observer failed")
 
     # -- health-aware staging (DESIGN.md §13) -----------------------------
 
@@ -904,9 +920,9 @@ class Client(Protocol):
                         "(t=%d) failed verification", variable, t,
                     )
                     return
-            self._backfills.submit(
-                variable, pkt.serialize(variable, value, t, sig, ss)
-            )
+            record = pkt.serialize(variable, value, t, sig, ss)
+            self._notify_certified(variable, record)
+            self._backfills.submit(variable, record)
 
     # -- batched write pipeline (no reference analog) ---------------------
 
@@ -1235,6 +1251,10 @@ class Client(Protocol):
                 results[i] = err
             else:
                 nok += 1
+                # data[k] is the certified record (phase 2 verified its
+                # completed collective signature) the quorum just
+                # committed — the gateway's write-through fill.
+                self._notify_certified(items[i][0], data[k])
         metrics.incr("client.write.ok", nok)
 
     def read_many(
@@ -1434,6 +1454,103 @@ class Client(Protocol):
             if err is not None:
                 raise err
             return value
+
+    def read_certified(
+        self, variable: bytes, proof=None
+    ) -> tuple[bytes | None, int, bytes | None]:
+        """One quorum read resolved over the COMPLETE fan-out, returned
+        WITH its certified record bytes: ``(value, t, record)`` where
+        ``record`` is the raw ``<x, t, v, ss>`` packet whose collective
+        signature this client verified (or certified on read) — the
+        reusable fill seam the edge gateway's read-through cache is
+        built on (DESIGN.md §14).  ``record`` is None exactly when the
+        read resolved empty (nothing stored / empty value at t=0).
+        Same resolution, revoke-on-read, and read-repair semantics as
+        :meth:`read`; raises the same errors on quorum failure."""
+        shard = self._shard_label(variable)
+        attrs = {}
+        if shard is not None:
+            attrs["shard"] = shard
+        with _shard_timer("client.read.latency", shard), trace.span(
+            "client.read_certified", attrs=attrs
+        ):
+            with trace.span("quorum.select"):
+                q = qm.choose_quorum_for(self.qs, variable, qm.READ)
+            req = pkt.serialize(variable, None, 0, None, proof)
+            m: dict = {}
+            fails: list = []
+
+            def cb(res: tp.MulticastResponse) -> bool:
+                err = self._process_response(res, m, variable)
+                if err is not None:
+                    fails.append(err)
+                return False  # full fan-out, as read() resolves
+
+            self.tr.multicast(tp.READ, q.nodes(), req, cb)
+            resolved = self._resolve_complete_fanout_many(
+                [m], q, key=variable
+            )
+            # Pending winners leave certified or get demoted — the
+            # no-bare-value rule the cache's soundness rests on.
+            self._certify_resolved([m], q, resolved, [variable], proof)
+            (res0,) = resolved
+            if res0 is None:
+                raise majority_error(
+                    [e for e in fails if e is not None],
+                    ERR_INSUFFICIENT_NUMBER_OF_RESPONSES,
+                )
+            value, maxt = res0
+            self._presession.lease_update(variable, maxt)
+            record = self._certified_bucket_record(m, value, maxt)
+            if value and record is None:
+                # Resolution fell back through _certify_resolved's
+                # demote path (_read_certified_only resolves from its
+                # OWN response map), so the winning certified bytes
+                # are not in ``m`` — re-collect them with one
+                # certified-only round.  Without this, a caller that
+                # needs the record (the gateway fill) would see "no
+                # data" for a variable that HAS a certified value.
+                m2: dict = {}
+                req2 = pkt.serialize(variable, None, 1, None, proof)
+
+                def cb2(res: tp.MulticastResponse) -> bool:
+                    self._process_response(res, m2, variable)
+                    return False
+
+                with trace.span("read.certified_record"):
+                    self.tr.multicast(tp.READ, q.nodes(), req2, cb2)
+                record = self._certified_bucket_record(m2, value, maxt)
+            metrics.incr("client.read.ok")
+        # Revoke-on-read + read-repair off the caller's critical path,
+        # exactly like the single read's worker tail.
+        worker = threading.Thread(
+            target=self._read_certified_post,
+            args=(q, m, value, maxt),
+            daemon=True,
+        )
+        worker.start()
+        return value, maxt, record
+
+    @staticmethod
+    def _certified_bucket_record(
+        m: dict, value, maxt: int
+    ) -> bytes | None:
+        """The raw completed-``ss`` packet backing ``(value, maxt)`` in
+        a response map, or None."""
+        if not value:
+            return None
+        for sv in m.get(maxt, {}).get(value or b"") or []:
+            if sv.ss is not None and sv.ss.completed and sv.packet:
+                return sv.packet
+        return None
+
+    def _read_certified_post(self, q, m, value, maxt) -> None:
+        try:
+            self._revoke_on_read(m)
+            if value:
+                self._write_back(q.nodes(), m, value, maxt)
+        except Exception:
+            log.exception("read_certified repair tail failed")
 
     def _read_worker(
         self, q, req: bytes, ch, variable: bytes, tctx=None, proof=None

@@ -120,10 +120,23 @@ class Universe:
     # group per shard; [servers] when unsharded).  Populated by
     # build_universe; consumers that predate sharding can ignore it.
     shards: list[list[Identity]] = field(default_factory=list)
+    # Edge gateway identities (bftkv_tpu/gateway): user-shaped
+    # principals (quorum-certified clients of every clique) that all
+    # share ONE uid — TOFU matches issuer id OR uid
+    # (server.go:329-337), so a variable written through gateway A can
+    # be overwritten through gateway B: the stateless tier is
+    # horizontally stackable without ownership pinning to one box.
+    # Their certificates carry NO address on purpose: the quorum plane
+    # is built from ADDRESSED vertices (wotqs ``W = U − {Ci} + R``,
+    # clique discovery, shard complements), and an addressed gateway
+    # cert would drag the front door into every principal's write
+    # plane.  Dial addresses are deployment config: ``gateway_addrs``.
+    gateways: list[Identity] = field(default_factory=list)
+    gateway_addrs: dict[str, str] = field(default_factory=dict)
 
     @property
     def all(self) -> list[Identity]:
-        return self.servers + self.storage_nodes + self.users
+        return self.servers + self.storage_nodes + self.users + self.gateways
 
     def certs(self) -> list[certmod.Certificate]:
         return [i.cert for i in self.all]
@@ -135,7 +148,9 @@ class Universe:
         by_id = {c.id: c for c in own}
         server_ids = {s.id for s in self.servers}
         rw_ids = {s.id for s in self.storage_nodes}
-        if any(u.id == identity.id for u in self.users):
+        if any(
+            u.id == identity.id for u in self.users + self.gateways
+        ):
             for c in own:
                 if (
                     c.id in server_ids and c.id not in self.cert_signer_ids
@@ -183,6 +198,8 @@ def build_universe(
     server_trust_rw: bool = False,
     alg: str = certmod.ALG_RSA,
     n_shards: int = 1,
+    n_gateways: int = 0,
+    gw_base_port: int = 6201,
 ) -> Universe:
     """The canonical test topology (reference: scripts/setup.sh:17-48).
 
@@ -203,6 +220,14 @@ def build_universe(
     servers, so one client identity carries a valid quorum certificate
     at every clique.  ``n_shards=1`` is byte-compatible with the
     pre-sharding topology.
+
+    ``n_gateways``: edge gateway identities (gw01..) — user-shaped
+    trust (quorum-certified, sign the servers in their own views) with
+    one SHARED uid across all gateways (TOFU interchangeability) and
+    deliberately NO certificate address: quorum planes are built from
+    addressed vertices, so an addressed gateway cert would enter every
+    principal's write plane (see Universe.gateways).  Dial addresses
+    are deployment config, returned in ``gateway_addrs``.
     """
     if not 1 <= n_shards <= len(_SHARD_PREFIXES):
         raise ValueError(f"n_shards must be 1..{len(_SHARD_PREFIXES)}")
@@ -268,6 +293,25 @@ def build_universe(
                 sign(s, u)  # quorum certificate on the user's cert
         users.append(u)
 
+    gateways = []
+    gateway_addrs: dict[str, str] = {}
+    for i in range(n_gateways):
+        name = f"gw{i + 1:02d}"
+        g = new_identity(
+            name,
+            # NO cert address (see Universe.gateways); the dial address
+            # is deployment config, returned beside the identity.
+            # ONE uid for the whole tier: TOFU ownership of a variable
+            # written through any gateway transfers to every other.
+            uid="gateway@bftkv",
+            bits=bits,
+            alg=alg_for(i),
+        )
+        gateway_addrs[name] = addr(name, gw_base_port + i)
+        for s in cert_signers:
+            sign(s, g)  # quorum certificate, like any signed user
+        gateways.append(g)
+
     return Universe(
         servers=servers,
         storage_nodes=storage_nodes,
@@ -275,6 +319,8 @@ def build_universe(
         cert_signer_ids={s.id for s in cert_signers},
         server_trust_rw=server_trust_rw,
         shards=shards,
+        gateways=gateways,
+        gateway_addrs=gateway_addrs,
     )
 
 

@@ -63,6 +63,8 @@ __all__ = [
     "SYNC_DIGEST",
     "SYNC_PULL",
     "WRITE_SIGN",
+    "GW_READ",
+    "GW_WRITE",
     "PREFIX",
     "COMMAND_NAMES",
     "MulticastResponse",
@@ -119,6 +121,17 @@ SYNC_PULL = 18
 # Old servers answer ERR_UNKNOWN_COMMAND and the client falls back to
 # the classic time → sign → write rounds for that quorum.
 WRITE_SIGN = 19
+# Edge gateway tier (bftkv_tpu/gateway; no reference analog): the
+# client-facing front-door commands.  GW_READ answers with a CERTIFIED
+# record <x,t,v,ss> (cache hit or verified quorum fill — the gateway
+# never serves bytes whose collective signature it has not verified
+# against the owner quorum, and the GatewayClient re-verifies, so a
+# compromised gateway cannot forge reads).  GW_WRITE hands the value to
+# the gateway's write coalescer, which signs and commits it upstream
+# under the gateway identity.  Quorum servers answer
+# ERR_UNKNOWN_COMMAND to both — only a Gateway handles them.
+GW_READ = 20
+GW_WRITE = 21
 
 PREFIX = "/bftkv/v1/"
 
@@ -143,6 +156,8 @@ COMMAND_NAMES = {
     SYNC_DIGEST: "sync_digest",
     SYNC_PULL: "sync_pull",
     WRITE_SIGN: "write_sign",
+    GW_READ: "gw_read",
+    GW_WRITE: "gw_write",
 }
 COMMANDS_BY_NAME = {v: k for k, v in COMMAND_NAMES.items()}
 

@@ -24,10 +24,16 @@ timeout):
   the quorum math already tolerates — amplification is bounded by the
   quorum size, the exact set the pre-staging fan-out always paid), so
   it fires early where the deadline fires late.
-- **gray flag** — a sample far above the peer's own p50 marks the peer
-  gray for ``GRAY_SECS`` (and bumps ``transport.peer.slow``, which the
-  fleet collector turns into a ``gray_member`` anomaly).  Health-aware
-  staging reads this flag to push gray peers out of the first wave.
+- **gray flag** — a sample far above the peer's own p50, OR a p50
+  persistently above the fleet's (3x the median of the OTHER peers'
+  p50s), marks the peer gray for ``GRAY_SECS`` (and bumps
+  ``transport.peer.slow``, which the fleet collector turns into a
+  ``gray_member`` anomaly).  Health-aware staging reads this flag to
+  push gray peers out of the first wave.  The fleet-relative clause is
+  what keeps a *consistently* slow peer flagged: a peer delayed on
+  every post absorbs the delay into its own p50 within half a ring,
+  and a purely self-relative rule would then clear the flag and drag
+  the straggler back into the first wave forever.
 
 All state is in-memory, advisory, and process-global (like
 ``transport.peer_health``): nothing here changes *which* responses a
@@ -156,7 +162,19 @@ class PeerLatency:
                 else p.ewma + self.ALPHA * (seconds - p.ewma)
             )
             p50 = self._quantile_locked(p, 0.5)
-            slow = timeout or (
+            # Fleet-relative persistence: a peer whose OWN p50 sits
+            # far above the other peers' median is gray even though
+            # each sample looks normal against its own (shifted)
+            # baseline.  None with <1 comparable other peer — the
+            # self-relative rule then stands alone, as before.
+            baseline = self._fleet_baseline_locked(addr)
+            persistent = (
+                baseline is not None
+                and p.samples >= 4
+                and p50 is not None
+                and p50 > max(self.GRAY_FACTOR * baseline, self.GRAY_ABS)
+            )
+            slow = timeout or persistent or (
                 p.samples >= 4
                 and p50 is not None
                 and seconds > max(self.GRAY_FACTOR * p50, self.GRAY_ABS)
@@ -171,6 +189,8 @@ class PeerLatency:
             ):
                 # A genuinely fast answer clears the flag early — a
                 # recovered peer must not stay demoted for GRAY_SECS.
+                # (A persistently-shifted p50 blocks this branch via
+                # ``persistent`` until the ring has genuinely drained.)
                 p.gray_until = 0.0
                 slow = was_gray = False
         if slow and not was_gray:
@@ -180,6 +200,22 @@ class PeerLatency:
             metrics.incr(
                 "transport.peer.slow", labels={"peer": _link_of(addr)}
             )
+
+    def _fleet_baseline_locked(self, exclude: str) -> float | None:
+        """Median of the OTHER warmed-up peers' p50s — the fleet's idea
+        of a normal RTT, against which a persistently shifted peer is
+        judged.  None when fewer than one other peer has history."""
+        p50s = [
+            q
+            for a, p in self._peers.items()
+            if a != exclude
+            and p.samples >= 4
+            and (q := self._quantile_locked(p, 0.5)) is not None
+        ]
+        if not p50s:
+            return None
+        p50s.sort()
+        return p50s[len(p50s) // 2]
 
     # -- queries -----------------------------------------------------------
 

@@ -27,12 +27,33 @@ class Cluster:
     servers: list[Server] = field(default_factory=list)  # quorum (a*)
     storage_servers: list[Server] = field(default_factory=list)  # rw*
     clients: list[Client] = field(default_factory=list)
+    gateways: list = field(default_factory=list)  # bftkv_tpu.gateway
+    gateway_addrs: dict[str, str] = field(default_factory=dict)
 
     @property
     def all_servers(self) -> list[Server]:
         return self.servers + self.storage_servers
 
+    def gateway_client(self, i: int = 0, *, verify: bool = True):
+        """A front-door client riding user ``i``'s identity against
+        every gateway of the cluster: the client's own keyring copies
+        of the (unaddressed) gateway certificates, paired with the
+        cluster's configured dial addresses."""
+        from bftkv_tpu.gateway import GatewayClient, GatewayPeer
+
+        client = self.clients[i]
+        peers = [
+            GatewayPeer(
+                client.crypt.keyring.get(gw.self_node.get_self_id()),
+                self.gateway_addrs[gw.self_node.name],
+            )
+            for gw in self.gateways
+        ]
+        return GatewayClient(client, peers, verify=verify)
+
     def stop(self) -> None:
+        for gw in self.gateways:
+            gw.stop()
         for s in self.all_servers:
             s.tr.stop()
 
@@ -58,6 +79,7 @@ def start_cluster(
     transport: str = "loop",
     alg: str = "rsa",
     n_shards: int = 1,
+    n_gateways: int = 0,
 ) -> Cluster:
     """``transport="loop"`` wires the in-process loopback net;
     ``transport="http"`` starts every server on a real localhost HTTP
@@ -76,6 +98,7 @@ def start_cluster(
             n_servers, n_users, n_rw, scheme="http", bits=bits,
             base_port=base, rw_base_port=base + 50,
             unsigned_users=unsigned_users, alg=alg, n_shards=n_shards,
+            n_gateways=n_gateways, gw_base_port=base + 80,
         )
         net = None
         make_tr = lambda crypt: http_cls(crypt)
@@ -83,6 +106,7 @@ def start_cluster(
         uni = topology.build_universe(
             n_servers, n_users, n_rw, scheme="loop", bits=bits,
             unsigned_users=unsigned_users, alg=alg, n_shards=n_shards,
+            n_gateways=n_gateways,
         )
         net = LoopbackNet()
         make_tr = lambda crypt: transport_cls(crypt, net)
@@ -98,4 +122,13 @@ def start_cluster(
     for ident in uni.users:
         graph, crypt, qs = topology.make_node(ident, uni.view_of(ident))
         cluster.clients.append(client_cls(graph, qs, make_tr(crypt), crypt))
+    for ident in uni.gateways:
+        from bftkv_tpu.gateway import Gateway
+
+        graph, crypt, qs = topology.make_node(ident, uni.view_of(ident))
+        gw = Gateway(graph, qs, make_tr(crypt), crypt)
+        dial = uni.gateway_addrs[ident.name]
+        gw.start(dial.split("://", 1)[-1])
+        cluster.gateways.append(gw)
+        cluster.gateway_addrs[ident.name] = dial
     return cluster

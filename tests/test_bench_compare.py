@@ -28,8 +28,8 @@ def detail_record(sections):
 def test_extracts_both_formats():
     d = extract_sections(driver_record({"cluster_4": ["cpu", 7.5],
                                         "rns_kernel": "skip"}))
-    assert d["cluster_4"] == ("cpu", 7.5, None)
-    assert d["rns_kernel"] == ("skip", None, None)
+    assert d["cluster_4"] == ("cpu", 7.5, None, None)
+    assert d["rns_kernel"] == ("skip", None, None, None)
     d = extract_sections(detail_record({
         "cluster_4": {"backend": "cpu", "writes_per_sec": 18.6,
                       "write_p50_s": 0.42},
@@ -37,13 +37,41 @@ def test_extracts_both_formats():
         "kernel": {"backend": "tpu", "rsa2048_verifies_per_sec": 5e5},
         "bad": {"error": "boom"},
     }))
-    assert d["cluster_4"] == ("cpu", 18.6, 0.42)
-    assert d["cluster_shards"] == ("cpu", 55.0, None)
+    assert d["cluster_4"] == ("cpu", 18.6, 0.42, None)
+    assert d["cluster_shards"] == ("cpu", 55.0, None, None)
     assert d["kernel"][1] == 5e5
-    assert d["bad"] == ("err", None, None)
+    assert d["bad"] == ("err", None, None, None)
     # three-element compact form (driver records after the round collapse)
     d = extract_sections(driver_record({"cluster_4": ["cpu", 7.5, 0.3]}))
-    assert d["cluster_4"] == ("cpu", 7.5, 0.3)
+    assert d["cluster_4"] == ("cpu", 7.5, 0.3, None)
+    # four-element compact form: the gray section's slowdown ratio
+    d = extract_sections(
+        driver_record({"cluster_4_gray": ["cpu", 20.0, 0.1, 1.8]})
+    )
+    assert d["cluster_4_gray"] == ("cpu", 20.0, 0.1, 1.8)
+    d = extract_sections(detail_record({
+        "cluster_4_gray": {"backend": "cpu", "writes_per_sec": 20.0,
+                           "write_p50_s": 0.1,
+                           "gray_slowdown_hedged": 1.7},
+    }))
+    assert d["cluster_4_gray"] == ("cpu", 20.0, 0.1, 1.7)
+
+
+def test_gray_slowdown_gated():
+    """cluster_4_gray left REPORT_ONLY: throughput/p50 gate like any
+    section, and the hedged slowdown is held under the absolute 2x
+    acceptance bound on the NEW record."""
+    old = driver_record({"cluster_4_gray": ["cpu", 20.0, 0.1, 1.5]})
+    ok = driver_record({"cluster_4_gray": ["cpu", 21.0, 0.1, 1.9]})
+    bad = driver_record({"cluster_4_gray": ["cpu", 21.0, 0.1, 2.4]})
+    _lines, regressions, compared = compare(old, ok)
+    assert regressions == [] and compared == 1
+    _lines, regressions, _ = compare(old, bad)
+    assert regressions == ["cluster_4_gray (gray_slowdown)"]
+    # an old record without the ratio still gates the new one
+    old2 = driver_record({"cluster_4_gray": ["cpu", 20.0, 0.1]})
+    _lines, regressions, _ = compare(old2, bad)
+    assert regressions == ["cluster_4_gray (gray_slowdown)"]
 
 
 def test_p50_latency_regression_gated():

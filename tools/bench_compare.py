@@ -38,14 +38,26 @@ import argparse
 import json
 import sys
 
-__all__ = ["compare", "extract_sections", "main", "REPORT_ONLY"]
+__all__ = [
+    "compare",
+    "extract_sections",
+    "main",
+    "GRAY_SLOWDOWN_MAX",
+    "REPORT_ONLY",
+]
 
-#: Sections printed but never gated.  cluster_4_gray is a fault-
-#: injection section (one member deliberately delayed): its absolute
-#: rate swings with the injected delay and the hedging knobs under
-#: test, so for its first landing it reports — the gray acceptance
-#: criterion lives in tests/test_hedge.py, not here.
-REPORT_ONLY = {"cluster_4_gray"}
+#: Sections printed but never gated.  Empty since BENCH_r07 landed
+#: cluster_4_gray: the gray section now gates like any other —
+#: throughput/p50 between rounds PLUS the absolute gray-slowdown bound
+#: below (it rode REPORT_ONLY only for its first landing, when there
+#: was no prior round to diff against).
+REPORT_ONLY: set = set()
+
+#: Absolute bound on the NEW record's hedged gray slowdown (write p50
+#: with one delayed clique member ÷ fault-free floor) — the DESIGN.md
+#: §13 acceptance bar, enforced on every committed round, not only in
+#: tests: ≤ f gray members may make writes slower, never >2× slower.
+GRAY_SLOWDOWN_MAX = 2.0
 
 
 def _backend_class(status: str) -> str:
@@ -54,7 +66,10 @@ def _backend_class(status: str) -> str:
 
 
 def extract_sections(doc: dict) -> dict:
-    """``{section name: (status, headline number | None, p50 | None)}``."""
+    """``{section name: (status, headline number | None, p50 | None,
+    gray_slowdown | None)}`` — the fourth element only the gray
+    section carries (compact records: a 4th list element; detail
+    records: ``gray_slowdown_hedged``)."""
     sections = None
     for path in (("parsed", "extra", "sections"), ("extra", "sections"),
                  ("sections",)):
@@ -74,16 +89,17 @@ def extract_sections(doc: dict) -> dict:
         return v if isinstance(v, (int, float)) else None
 
     for name, sec in sections.items():
-        if isinstance(sec, (list, tuple)) and len(sec) in (2, 3):
+        if isinstance(sec, (list, tuple)) and len(sec) in (2, 3, 4):
             status = sec[0]
-            p50 = num(sec[2]) if len(sec) == 3 else None
-            out[name] = (str(status), num(sec[1]), p50)
+            p50 = num(sec[2]) if len(sec) >= 3 else None
+            gray = num(sec[3]) if len(sec) >= 4 else None
+            out[name] = (str(status), num(sec[1]), p50, gray)
         elif isinstance(sec, dict):
             if "skipped" in sec:
-                out[name] = ("skip", None, None)
+                out[name] = ("skip", None, None, None)
                 continue
             if "error" in sec:
-                out[name] = ("err", None, None)
+                out[name] = ("err", None, None, None)
                 continue
             n = sec.get("writes_per_sec")
             if not isinstance(n, (int, float)):
@@ -97,10 +113,13 @@ def extract_sections(doc: dict) -> dict:
                     None,
                 )
             out[name] = (
-                str(sec.get("backend", "?")), n, num(sec.get("write_p50_s"))
+                str(sec.get("backend", "?")),
+                n,
+                num(sec.get("write_p50_s")),
+                num(sec.get("gray_slowdown_hedged")),
             )
         elif isinstance(sec, str):
-            out[name] = (sec, None, None)
+            out[name] = (sec, None, None, None)
     return out
 
 
@@ -122,7 +141,7 @@ def compare(
     for name in shared:
         if prefix and not name.startswith(prefix):
             continue
-        (sa, va, pa), (sb, vb, pb) = a[name], b[name]
+        (sa, va, pa, _ga), (sb, vb, pb, gb) = a[name], b[name]
         if name in REPORT_ONLY:
             lines.append(
                 f"  {name}: {va} -> {vb}  (report-only, not gated)"
@@ -160,6 +179,38 @@ def compare(
                 f"  {name} write p50: {pa:g}s -> {pb:g}s  "
                 f"({lratio:.2f}x)  {lverdict}"
             )
+        # Gray axis: an ABSOLUTE bound on the new record, not a
+        # round-over-round ratio — 2.1× vs 2.0× is a tiny relative
+        # move but a broken acceptance bar (only the new side needs
+        # the value; older records never carried it).
+        if gb is not None:
+            gverdict = "ok"
+            if gb > GRAY_SLOWDOWN_MAX:
+                gverdict = (
+                    f"REGRESSION (> {GRAY_SLOWDOWN_MAX:g}x bound)"
+                )
+                regressions.append(f"{name} (gray_slowdown)")
+            lines.append(
+                f"  {name} gray slowdown (hedged): {gb:g}x  "
+                f"(bound {GRAY_SLOWDOWN_MAX:g}x)  {gverdict}"
+            )
+    # The gray bound is ABSOLUTE, so a section new in this round (no
+    # old side to diff) is still held to it.
+    for name in sorted(set(b) - set(a)):
+        if prefix and not name.startswith(prefix):
+            continue
+        gb = b[name][3]
+        if gb is None:
+            continue
+        gverdict = "ok"
+        if gb > GRAY_SLOWDOWN_MAX:
+            gverdict = f"REGRESSION (> {GRAY_SLOWDOWN_MAX:g}x bound)"
+            regressions.append(f"{name} (gray_slowdown)")
+        compared += 1
+        lines.append(
+            f"  {name} gray slowdown (hedged): {gb:g}x  "
+            f"(bound {GRAY_SLOWDOWN_MAX:g}x, new section)  {gverdict}"
+        )
     if not any(name.startswith(prefix) for name in shared):
         lines.append(f"  (no shared '{prefix}*' sections)")
     return lines, regressions, compared
