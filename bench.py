@@ -1568,6 +1568,229 @@ def bench_cluster_shards(
     return out
 
 
+def bench_cluster_split(
+    servers_per_shard: int = 4,
+    rw_per_shard: int = 4,
+    writers: int = 8,
+    writes_per_phase: int = 20,
+    *,
+    value_size: int = 512,
+    bits: int = 1024,
+    zipf: float = 1.1,
+) -> dict:
+    """Elastic topology autopilot proof (DESIGN.md §15): a zipf-skewed
+    workload whose hot keys all hash-route to ONE shard of a 2-shard
+    fleet triggers an AUTOMATIC hot-shard split — no manual
+    intervention — and aggregate writes/s rises once the hot buckets
+    spread across both cliques.  Three measured phases:
+
+    - **pre**: closed-loop writers on the hot key set (all on the hot
+      shard; the other clique idles);
+    - **flip window**: the same writers keep writing WHILE the
+      autopilot detects the skew and executes pre-copy → flip → drain;
+      per-write success is recorded — write availability must never
+      drop to zero across the flip (stale writers re-route in-round
+      off hinted declines);
+    - **post**: the same workload on the rebalanced table.
+
+    Reports pre/post rates, the flip-window p99 and failure count, and
+    the route-table epochs the fleet traversed."""
+    from bftkv_tpu.autopilot import Autopilot
+    from bftkv_tpu.metrics import registry as metrics
+    from bftkv_tpu.ops import dispatch
+    from bftkv_tpu.storage.memkv import MemStorage
+    from tests.cluster_utils import start_cluster
+
+    t_setup = time.perf_counter()
+    cluster = start_cluster(
+        servers_per_shard,
+        writers,
+        rw_per_shard,
+        bits=bits,
+        storage_factory=MemStorage,
+        n_shards=2,
+    )
+    setup_s = time.perf_counter() - t_setup
+    servers, clients = cluster.all_servers, cluster.clients
+    try:
+        dispatch.install(dispatch.VerifyDispatcher(max_batch=256))
+        dispatch.install_signer(dispatch.SignDispatcher(max_batch=128))
+        value = os.urandom(value_size)
+        qs0 = clients[0].qs
+        hot_shard = 0
+        # Hot key set: per-writer slices, every key routed to ONE shard
+        # (the zipf knob then skews popularity INSIDE the set — the
+        # workload shape ROADMAP item 4 names).
+        hot_keys: dict[int, list[bytes]] = {}
+        for ci in range(writers):
+            ks, i = [], 0
+            while len(ks) < max(writes_per_phase, 8) and i < 65536:
+                k = b"bench/split/%d/%d" % (ci, i)
+                i += 1
+                if qs0.shard_of(k) == hot_shard:
+                    ks.append(k)
+            hot_keys[ci] = ks
+        probs = _zipf_probs(max(writes_per_phase, 8), zipf)
+
+        # Warmup: one write per (writer, shard) for sessions + leases.
+        for ci, c in enumerate(clients[:writers]):
+            seen: set = set()
+            k = 0
+            while len(seen) < 2 and k < 4096:
+                key = b"bench/split/warm/%d/%d" % (ci, k)
+                si = qs0.shard_of(key)
+                if si not in seen:
+                    seen.add(si)
+                    c.write(key, value)
+                k += 1
+        for c in clients[:writers]:
+            if hasattr(c, "drain_tails"):
+                c.drain_tails()
+        for c in clients[:writers]:
+            c.qs.reset_bucket_load()
+
+        lock = threading.Lock()
+        samples: list[tuple[float, float, bool]] = []  # (ts, dt, ok)
+
+        def run_phase(tag: str, stop_evt=None, n=writes_per_phase,
+                      think: float = 0.0):
+            """One write burst; returns (ok_writes, elapsed).  ``think``
+            paces the loop (the flip window wants CONTINUOUS
+            availability probes, not saturation — an unpaced window
+            writes thousands of versions whose churn would dominate
+            the post-phase measurement)."""
+            errors: list = []
+
+            def run(ci: int, client) -> None:
+                rng = np.random.default_rng(7000 + ci)
+                i = 0
+                while (
+                    (stop_evt is None and i < n)
+                    or (stop_evt is not None and not stop_evt.is_set())
+                ):
+                    i += 1
+                    ks = hot_keys[ci]
+                    var = ks[int(rng.choice(len(probs), p=probs)) % len(ks)]
+                    t1 = time.perf_counter()
+                    try:
+                        client.write(var, value + i.to_bytes(4, "big"))
+                        ok = True
+                    except Exception as e:
+                        ok = _is_write_conflict(e)
+                        if not ok:
+                            errors.append(e)
+                            ok = False
+                    with lock:
+                        samples.append(
+                            (t1, time.perf_counter() - t1, ok)
+                        )
+                    if think:
+                        time.sleep(think)
+
+            threads = [
+                threading.Thread(target=run, args=(ci, c), daemon=True)
+                for ci, c in enumerate(clients[:writers])
+            ]
+            t0 = time.perf_counter()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            el = time.perf_counter() - t0
+            with lock:
+                ok_n = sum(1 for ts, _dt, ok in samples if ok and ts >= t0)
+            return ok_n, el, errors
+
+        # Phase 1 — pre-split rate (hot shard only).
+        ok_pre, el_pre, _ = run_phase("pre")
+        pre_rate = ok_pre / el_pre
+
+        # Phase 2 — the autopilot decides + executes WHILE writers run.
+        metrics.reset()  # reroute/decline counters cover flip + post
+        ap = Autopilot.for_cluster(cluster)
+        plan = ap.decide()
+        auto_decided = plan is not None
+        stop = threading.Event()
+        flip_fail = [0]
+        mig: dict = {}
+
+        def migrate():
+            try:
+                if plan is not None:
+                    mig.update(ap.execute(plan, pace=0.05))
+                else:
+                    mig.update(ap.force_split(hot_shard, pace=0.05))
+            finally:
+                stop.set()
+
+        t_flip0 = time.perf_counter()
+        mthread = threading.Thread(target=migrate, daemon=True)
+        mthread.start()
+        ok_flip, el_flip, errs_flip = run_phase(
+            "flip", stop_evt=stop, think=0.05
+        )
+        mthread.join(timeout=120)
+        flip_fail[0] = len(errs_flip)
+        flip_samples = [
+            dt for ts, dt, ok in samples if ok and ts >= t_flip0
+        ]
+        flip_p99 = (
+            round(float(np.percentile(flip_samples, 99)), 4)
+            if flip_samples
+            else None
+        )
+
+        # Phase 3 — post-split rate on the rebalanced table.
+        for c in clients[:writers]:
+            if hasattr(c, "drain_tails"):
+                c.drain_tails()
+        ok_post, el_post, _ = run_phase("post")
+        post_rate = ok_post / el_post
+        for c in clients[:writers]:
+            if hasattr(c, "drain_tails"):
+                c.drain_tails()
+
+        snap = metrics.snapshot()
+        moved = sum(
+            1
+            for ci in range(writers)
+            for k in hot_keys[ci]
+            if qs0.shard_of(k) != hot_shard
+        )
+        total_keys = sum(len(v) for v in hot_keys.values())
+        return {
+            "shards": 2,
+            "writers": writers,
+            "zipf_s": zipf,
+            "auto_decided": auto_decided,
+            "migration_ok": bool(mig.get("ok")),
+            "epoch": mig.get("final_epoch") or mig.get("epoch"),
+            "moved_hot_keys": moved,
+            "hot_keys": total_keys,
+            "pre_writes_per_sec": round(pre_rate, 2),
+            "writes_per_sec": round(post_rate, 2),  # headline: post
+            "post_writes_per_sec": round(post_rate, 2),
+            "speedup_post_vs_pre": round(post_rate / max(pre_rate, 1e-9), 2),
+            "flip_window_s": round(el_flip, 3),
+            "flip_window_writes": ok_flip,
+            "flip_window_failures": flip_fail[0],
+            "flip_window_errors": sorted(
+                {repr(e)[:80] for e in errs_flip}
+            )[:5],
+            "flip_window_p99_s": flip_p99,
+            "availability_held": ok_flip > 0 and flip_fail[0] == 0,
+            "rerouted": snap.get("client.route.rerouted", 0),
+            "write_p50_s": round(
+                snap.get("client.write.latency.p50", 0), 4
+            ),
+            "setup_s": round(setup_s, 1),
+        }
+    finally:
+        dispatch.uninstall_all()
+        for s in servers:
+            s.tr.stop()
+
+
 def bench_threshold(rounds: int = 3) -> dict:
     """BASELINE config 3/4 signing: live (t,n)=(5,9) threshold CA over a
     9-replica cluster — RSA-2048 and ECDSA P-256 dist_sign rounds
@@ -1722,6 +1945,7 @@ SECTION_NAMES = {
     "bmix64": "cluster_64_batched_mix",
     "bmix64ec": "cluster_64_batched_mix_ec",
     "cshards": "cluster_shards",
+    "csplit": "cluster_split",
     "c4gray": "cluster_4_gray",
     "cgw": "cluster_gateway",
     "thr": "threshold_5_9",
@@ -1734,7 +1958,7 @@ SECTION_NAMES = {
 # backend; cluster_4_gray is hedged-vs-unhedged on the same box, also
 # self-relative; cluster_gateway is gateway-vs-direct on the same box,
 # likewise self-relative.
-CPU_OK = {"tally", "c4", "cshards", "c4gray", "cgw"}
+CPU_OK = {"tally", "c4", "cshards", "csplit", "c4gray", "cgw"}
 
 # Per-section subprocess timeouts (seconds).  The flapping tunnel makes
 # a hung section indistinguishable from a slow one until the timeout
@@ -1748,7 +1972,7 @@ TOKEN_TIMEOUT = {
     "c4": 900, "c4http": 900, "c4ec": 900, "c16": 900, "c4gray": 900,
     "cgw": 900,
     "b16": 1200, "b64": 1500, "bmix64": 1500, "bmix64ec": 1500,
-    "c64": 1500, "mix64": 1500, "cshards": 1500,
+    "c64": 1500, "mix64": 1500, "cshards": 1500, "csplit": 900,
 }
 
 # Headline preference: batched 64-replica pipeline first (the TPU-native
@@ -1827,6 +2051,15 @@ def _section_spec(token: str):
             shard_counts=(1, 2) if FAST else (1, 2, 4),
             writes_per_writer=3 if FAST else 6,
             zipf=zipf,
+        ),
+        # Elastic topology autopilot (ROADMAP item 4): a zipf-skewed
+        # hot-shard workload must trigger an AUTOMATIC split with no
+        # manual intervention; reports pre/post rates and the
+        # flip-window availability/p99 (DESIGN.md §15).
+        "csplit": lambda: bench_cluster_split(
+            writers=4 if FAST else 8,
+            writes_per_phase=6 if FAST else 20,
+            zipf=zipf if zipf > 0 else 1.1,
         ),
         # Gray failure: one slow-but-alive clique member; hedging +
         # health-aware staging vs the fixed-timeout behavior, plus the

@@ -1,0 +1,27 @@
+"""Elastic topology autopilot (DESIGN.md §15).
+
+Detect → decide → execute for live topology changes: epoched route
+tables (``quorum/wotqs.RouteTable``), hot-shard splits, clique
+retirement under traffic, and spare-replica admission — all riding the
+background anti-entropy / repair planes, never the write's one-round
+critical path.
+
+- :mod:`bftkv_tpu.autopilot.plan` — pure decisions (split / retire);
+- :mod:`bftkv_tpu.autopilot.daemon` — the 3-phase executor
+  (pre-copy → flip → drain) and the watch loop;
+- ``python -m bftkv_tpu.autopilot`` — standalone watcher over a
+  ``/fleet`` endpoint (``run_cluster --autopilot`` boots it).
+
+``BFTKV_AUTOPILOT=off`` disables automatic decisions.
+"""
+
+from bftkv_tpu.autopilot.daemon import Autopilot, autopilot_enabled
+from bftkv_tpu.autopilot.plan import Plan, decide, next_table
+
+__all__ = [
+    "Autopilot",
+    "Plan",
+    "autopilot_enabled",
+    "decide",
+    "next_table",
+]

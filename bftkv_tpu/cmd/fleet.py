@@ -44,6 +44,34 @@ def render(doc: dict) -> str:
             "UNSEATED (never answered /info — shard budgets "
             f"indeterminate): {', '.join(fl['unseated'])}"
         )
+    repochs = fl.get("route_epochs") or {}
+    if isinstance(repochs.get("max"), int) and repochs["max"] > 0:
+        lines.append(
+            f"route table: epoch {repochs['max']}"
+            + (
+                f" (SKEWED — some members still at {repochs['min']})"
+                if repochs.get("skewed")
+                else ""
+            )
+        )
+    ap = doc.get("autopilot")
+    if ap:
+        last = ap.get("last") or {}
+        lines.append(
+            "autopilot: "
+            + ("on" if ap.get("enabled") else "OFF (BFTKV_AUTOPILOT)")
+            + f" · epoch {ap.get('epoch')}"
+            + f" · migrations {ap.get('migrations', 0)}"
+            + (
+                f" · last {last['kind']}: shard {last.get('shard')} → "
+                f"{last.get('targets')} ({last.get('buckets')} buckets, "
+                f"{'ok' if last.get('ok') else 'in flight/blocked'})"
+                if last.get("kind")
+                else ""
+            )
+        )
+        if ap.get("retired"):
+            lines.append(f"  retired cliques: {ap['retired']}")
     for sh, sd in sorted(doc["shards"].items()):
         fb = sd["f_budget"]
         slo = sd.get("slo", {})
@@ -68,9 +96,11 @@ def render(doc: dict) -> str:
         )
         for mem in sd["members"]:
             mark = "·" if mem["status"] == "up" else "✗"
+            ep = mem.get("epoch")
             lines.append(
                 f"  {mark} {mem['name']} [{mem['role'] or '?'}] "
                 f"{mem['status']}"
+                + (f" e{ep}" if isinstance(ep, int) and ep > 0 else "")
             )
         for ex in sd.get("exemplars", [])[-3:]:
             lines.append(

@@ -65,6 +65,7 @@ def spawn(
     chaos_seed: int | None = None,
     fleet: int = 0,
     fleet_interval: float = 2.0,
+    autopilot: bool = False,
     gw_homes: list[str] | None = None,
     gw_sync_invalidate: float = 5.0,
     extra_env: dict | None = None,
@@ -77,6 +78,9 @@ def spawn(
         # (raising mid-spawn would orphan the just-launched fleet).
         raise ValueError("--fleet needs --api-base (it scrapes the "
                          "daemon APIs)")
+    if autopilot and not fleet:
+        raise ValueError("--autopilot needs --fleet (it watches the "
+                         "collector's /fleet document)")
     os.makedirs(db_root, exist_ok=True)
     procs = []
     env = dict(os.environ, **(extra_env or {}))
@@ -166,6 +170,21 @@ def spawn(
                 env=env,
             )
         )
+    if autopilot:
+        # Advisory watcher over the collector's /fleet document: prints
+        # retire/split decisions as JSON lines (BFTKV_AUTOPILOT=off
+        # silences it).  In-process fleets (nemesis, benches, tests)
+        # run the executing Autopilot directly.
+        procs.append(
+            subprocess.Popen(
+                [
+                    sys.executable, "-m", "bftkv_tpu.autopilot",
+                    "--fleet-url", f"http://127.0.0.1:{fleet}/fleet",
+                    "--interval", str(max(fleet_interval * 2, 2.0)),
+                ],
+                env=env,
+            )
+        )
     return procs
 
 
@@ -225,6 +244,12 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--fleet-interval", type=float, default=2.0,
                     metavar="SECONDS",
                     help="collector scrape interval")
+    ap.add_argument("--autopilot", action="store_true",
+                    help="boot the topology autopilot watcher beside "
+                         "the fleet collector (needs --fleet): it "
+                         "consumes /fleet and prints split/retire "
+                         "decisions as JSON lines "
+                         "(BFTKV_AUTOPILOT=off disables)")
     ap.add_argument("--shards", type=int, default=0, metavar="N",
                     help="one-box sharded quickstart: when --keys holds "
                          "no server homes yet, generate an N-clique "
@@ -260,6 +285,10 @@ def main(argv: list[str] | None = None) -> int:
         print("--fleet needs --api-base (the collector scrapes the "
               "daemon APIs)", file=sys.stderr)
         return 1
+    if args.autopilot and not args.fleet:
+        print("--autopilot needs --fleet (it watches the collector's "
+              "/fleet document)", file=sys.stderr)
+        return 1
     gw_homes = gateway_homes(args.keys)[: args.gateways]
     if args.gateways and len(gw_homes) < args.gateways:
         print(f"--gateways {args.gateways} but only {len(gw_homes)} gw* "
@@ -275,7 +304,7 @@ def main(argv: list[str] | None = None) -> int:
                   rpc_timeout=args.rpc_timeout,
                   chaos_seed=args.chaos_seed,
                   fleet=args.fleet, fleet_interval=args.fleet_interval,
-                  gw_homes=gw_homes)
+                  autopilot=args.autopilot, gw_homes=gw_homes)
     if args.fleet:
         print(f"run_cluster: fleet health @ http://127.0.0.1:{args.fleet}"
               "/fleet", flush=True)

@@ -141,6 +141,42 @@ ERR_UNKNOWN_SESSION = new_error("unknown transport session")
 # Byzantine request dies in admission.
 ERR_WRONG_SHARD = new_error("wrong shard")
 
+
+def wrong_shard_error(
+    epoch: int | None = None, owner: int | None = None
+) -> type[Error]:
+    """The wrong-shard decline, optionally carrying a routing hint:
+    the responder's route-table epoch and the owning shard index, so a
+    stale-route client re-routes in-round instead of failing.  The
+    bare form is kept for legacy servers (and for epoch-0 fleets,
+    where there is nothing to hint) — both intern and tunnel through
+    the x-error header like any other protocol error."""
+    if epoch is None or owner is None:
+        return ERR_WRONG_SHARD
+    return new_error(f"wrong shard epoch={int(epoch)} owner={int(owner)}")
+
+
+def parse_wrong_shard(err: object) -> tuple[int | None, int | None] | None:
+    """``None`` if ``err`` is not a wrong-shard decline; else the
+    ``(epoch, owner)`` hint — ``(None, None)`` for the bare legacy
+    form.  Accepts error classes, instances, and wire strings."""
+    m = _message_of(err)
+    if m is None and isinstance(err, str):
+        m = err
+    if m is None or not m.startswith("wrong shard"):
+        return None
+    rest = m[len("wrong shard"):].strip()
+    if not rest:
+        return (None, None)
+    out: dict[str, int] = {}
+    for part in rest.split():
+        k, sep, v = part.partition("=")
+        if sep and v.isdigit():
+            out[k] = int(v)
+    if "epoch" in out and "owner" in out:
+        return (out["epoch"], out["owner"])
+    return (None, None)
+
 # Edge gateway tier (this framework's addition, no reference analog):
 # the gateway's bounded admission queue is full — the caller should
 # back off or try another gateway; quorum state is untouched.

@@ -201,11 +201,19 @@ class SafetyChecker:
         f: int,
         shard_of_node: dict[str, int] | None = None,
         routing_stable: bool = False,
+        routing_changed: bool = False,
     ):
         self.recorder = recorder
         self.f = f
         self.shard_of_node = shard_of_node
         self.routing_stable = routing_stable
+        #: A route-table epoch advanced during the run (autopilot split
+        #: / retirement / route_flap): invariant 3's backing signature
+        #: may then legitimately verify against the clique that owned
+        #: the bucket when the value committed, not the current owner —
+        #: the read-integrity search widens to every shard's quorum.
+        #: Invariant 5 (no cross-shard equivocation) is untouched.
+        self.routing_changed = routing_changed
 
     def check(self, honest_servers: Iterable) -> list[str]:
         """Returns human-readable violations (empty = safe run).
@@ -310,20 +318,43 @@ class SafetyChecker:
                     or not p.ss.completed
                 ):
                     continue
-                try:
-                    # Keyed: the signature must verify against the
-                    # quorum of the shard that OWNS the variable — a
-                    # value endorsed only by a foreign clique is not
-                    # backed.
-                    srv.crypt.collective.verify(
-                        pkt.tbss(raw),
-                        p.ss,
-                        qm.choose_quorum_for(srv.qs, variable, qm.AUTH),
-                        srv.crypt.keyring,
-                    )
-                    return True
-                except Exception:
-                    continue
+                # Keyed: the signature must verify against the quorum
+                # of the shard that OWNS the variable — a value
+                # endorsed only by a foreign clique is not backed.
+                # After an epoch change (routing_changed) the THEN
+                # owner is also acceptable FOR MOVED BUCKETS ONLY:
+                # migration moves certified history between cliques by
+                # design, but a variable whose bucket never moved must
+                # still verify against its one owner — widening the
+                # audit fleet-wide would let a cross-shard laundering
+                # bug hide behind any unrelated epoch bump.
+                quorums = [
+                    qm.choose_quorum_for(srv.qs, variable, qm.AUTH)
+                ]
+                moved = getattr(
+                    srv.qs, "bucket_moved", lambda _v: True
+                )
+                if self.routing_changed and moved(variable):
+                    qfs = getattr(srv.qs, "quorum_for_shard", None)
+                    nsh = getattr(srv.qs, "shard_count", lambda: 1)()
+                    if qfs is not None:
+                        # Verify view: the auditor judges signatures
+                        # against each clique's own suff, exactly as
+                        # migration admission does.
+                        quorums += [
+                            qfs(i, qm.AUTH, True) for i in range(nsh)
+                        ]
+                for quorum in quorums:
+                    try:
+                        srv.crypt.collective.verify(
+                            pkt.tbss(raw),
+                            p.ss,
+                            quorum,
+                            srv.crypt.keyring,
+                        )
+                        return True
+                    except Exception:
+                        continue
         return False
 
     # -- 4. no two conflicting values both gather 2f+1 acks ---------------

@@ -39,6 +39,7 @@ plan + fault-trace summary as JSON (``--json``) for the CI soak lane.
 from __future__ import annotations
 
 import random
+import threading
 import time
 
 from bftkv_tpu.faults import byzantine, failpoint as fp
@@ -55,6 +56,7 @@ STEP_KINDS = (
     "stale_replay",
     "collude",
     "slow_node",
+    "route_flap",
 )
 
 
@@ -64,10 +66,19 @@ class Nemesis:
         cluster: ChaosCluster,
         seed: int = 0,
         registry: fp.FaultRegistry | None = None,
+        autopilot: bool = False,
     ):
         self.cluster = cluster
         self.seed = seed
         self.registry = registry or fp.registry
+        #: Topology autopilot under test: built in :meth:`run` (it
+        #: wants the collector), drives ONE forced migration while the
+        #: second half of the fault schedule lands — reconfiguration
+        #: under chaos, the DESIGN.md §15 acceptance shape.  The
+        #: ``route_flap`` step kind needs it too (it ships tables).
+        self._want_autopilot = autopilot
+        self.autopilot = None
+        self._migration: dict | None = None
         self._written: dict[bytes, bytes] = {}
         self.failures = {"write": 0, "read": 0}
         #: Fleet health collector watching the same cluster — the chaos
@@ -146,10 +157,31 @@ class Nemesis:
         gw_names = sorted(
             getattr(self.cluster, "gateway_names", lambda: [])()
         )
+        # route_flap needs ≥ 2 shards AND the autopilot (it ships the
+        # epoch-N+1 table); unsupported configs degrade the kind to a
+        # partition so a seeded plan stays runnable everywhere.
+        flap_ok = (
+            self._want_autopilot
+            and len(getattr(uni, "shards", None) or []) > 1
+        )
+        client_names = sorted(
+            getattr(c.self_node, "name", f"u{i + 1:02d}")
+            for i, c in enumerate(
+                getattr(self.cluster, "clients", []) or []
+            )
+        ) or ["u01"]
         out = []
         for i in range(steps):
             kind = kinds[rng.randrange(len(kinds))]
-            if kind == "stale_replay":
+            if kind == "route_flap" and not flap_ok:
+                kind = "partition"
+            if kind == "route_flap":
+                # The held-back principal is a CLIENT: its writes keep
+                # routing on epoch N, land on the old owner, and must
+                # re-route off the hinted decline — the fault class the
+                # epoch_stale counter and epoch_skew anomaly exist for.
+                pool = client_names
+            elif kind == "stale_replay":
                 pool = storage
             elif kind == "slow_node":
                 # Gray CLIQUE members are the interesting case: they
@@ -409,12 +441,27 @@ class Nemesis:
             idx = idx_of(s.self_node.get_self_id())
             return "all" if idx is None else idx
 
+        def owned_root(s) -> object:
+            """The digest root restricted to buckets the replica OWNS:
+            after a migration the old owner's moved-bucket copies are
+            inert by design (never synced again), so the full-tree
+            root would diverge forever without any safety meaning."""
+            tree = s._sync_tree()
+            owned = getattr(s.qs, "owned_buckets", lambda: None)()
+            if owned is None:
+                return tree.root()
+            return tuple(
+                sorted(
+                    (b, h)
+                    for b, h in tree.buckets().items()
+                    if b in owned
+                )
+            )
+
         def converged() -> bool:
             roots: dict[object, set] = {}
             for s in replicas:
-                roots.setdefault(group_of(s), set()).add(
-                    s._sync_tree().root()
-                )
+                roots.setdefault(group_of(s), set()).add(owned_root(s))
             return all(len(r) == 1 for r in roots.values())
 
         daemons = [
@@ -432,6 +479,24 @@ class Nemesis:
         return converged()
 
     # -- detection (the observability plane under test) --------------------
+
+    def _forced_migration(self) -> None:
+        """One forced hot-shard split, executed on a side thread while
+        the fault schedule keeps landing.  A pre-copy blocked by an
+        active fault window ABORTS without flipping (correct behavior,
+        like failing writes under partition) — retried a couple of
+        times so the migration completes once the window heals.  A
+        migration that never completes is a report (and a run-failing
+        violation), never a crash of the nemesis itself."""
+        for attempt in range(3):
+            try:
+                self._migration = self.autopilot.force_split(pace=0.4)
+            except Exception as e:
+                self._migration = {"ok": False, "error": repr(e)}
+                return
+            if self._migration.get("ok"):
+                return
+            time.sleep(1.0 + attempt)
 
     def _make_collector(self):
         from bftkv_tpu import trace as trmod
@@ -470,6 +535,13 @@ class Nemesis:
 
         def hit() -> bool:
             fresh = self.collector.anomalies(since_seq=seq0)
+            if kind == "route_flap":
+                # The stale-routed client's declined writes surface as
+                # the old owner's server.epoch_stale counter delta →
+                # epoch_skew anomaly (source is the process-wide
+                # metrics feed on loopback clusters, so kind alone is
+                # the match).
+                return any(a["kind"] == "epoch_skew" for a in fresh)
             if kind == "crash_restart":
                 # The plane "sees" an outage either as a fresh
                 # member_down transition or as the member simply BEING
@@ -503,14 +575,29 @@ class Nemesis:
                     addr = ""
                 if addr and _tp.peer_latency.is_gray(addr):
                     return True
-                return any(
+                if any(
                     (a["kind"] == "fault" and a["source"] == target)
                     or (
                         a["kind"] == "gray_member"
                         and target in a["detail"]
                     )
                     for a in fresh
+                ):
+                    return True
+                # Vacuous window: the delay rule never FIRED — health-
+                # aware staging (or an earlier gray verdict whose flag
+                # has since decayed) kept every post off the target.
+                # An uncrossed fault is undetectable by construction
+                # (the plan()'s honesty rule), so a zero-fire window
+                # counts as detected; when the rule DID fire, only the
+                # real channels above count — a crossed fault must
+                # surface in the health feed.
+                fired = any(
+                    e.rule_id == f"slow_node:{target}"
+                    and e.seq > step.get("_fp_seq0", 0)
+                    for e in self.registry.trace()
                 )
+                return not fired
             return any(
                 a["kind"] == "fault" and a["source"] == target
                 for a in fresh
@@ -559,6 +646,11 @@ class Nemesis:
                     time.sleep(dwell)
             finally:
                 self.cluster.restart(target)
+                if self.autopilot is not None:
+                    # A restarted replica boots at epoch 0; re-deliver
+                    # the current table or it would resurrect HRW
+                    # routing for buckets that migrated away.
+                    self.autopilot.reconcile()
         elif kind == "clock_skew":
             rules = self.clock_skew(target, step["delta"])
             try:
@@ -575,6 +667,8 @@ class Nemesis:
                 self.heal(rules)
         elif kind == "slow_node":
             w0 = self.failures["write"]
+            ev = self.registry.trace()
+            step["_fp_seq0"] = ev[-1].seq if ev else 0
             rules = self.slow_node(
                 target, step["seconds"], step.get("mode", "all")
             )
@@ -608,8 +702,66 @@ class Nemesis:
                 self._observe_window(step, seq0)
             finally:
                 self.registry.remove_all(rules)
+        elif kind == "route_flap":
+            self._route_flap(step, tag, seq0)
         else:  # pragma: no cover
             raise ValueError(f"unknown step kind {kind!r}")
+
+    def _route_flap(self, step: dict, tag: str, seq0: int) -> None:
+        """Epoch N+1 delivered to everyone EXCEPT ``target`` (a
+        client) for one window: the window's own traffic keys are the
+        moving buckets, so the stale client's writes land on the old
+        owner, decline with the routing hint, and must re-route
+        in-round — surfacing as ``server.epoch_stale`` →
+        ``epoch_skew`` in the health feed.  Healing delivers the
+        held-back table."""
+        from bftkv_tpu.quorum.wotqs import route_bucket
+
+        ap = self.autopilot
+        target = step["target"]
+        cl = self._client(0)
+        qs = cl.qs
+        nsh = qs.shard_count()
+        owner = qs.effective_route()
+        shard_of = qs.shard_of
+        # Candidate moving buckets: this window's OWN write keys (the
+        # three singles plus the per-shard batch keys traffic() will
+        # select with the same arithmetic).  Buckets holding HISTORY
+        # are excluded — an abrupt flip ships no pre-copy, so moving a
+        # populated bucket would strand its records at the old owner
+        # (readers route to the new one); fresh-key buckets carry
+        # nothing and the fault still manifests on this window's
+        # writes.  Real migrations move populated buckets through the
+        # full pre-copy/dual/drain machinery instead.
+        candidates = [f"chaos/{tag}/{i}".encode() for i in range(3)]
+        for s in range(nsh):
+            picked, i = 0, 0
+            while picked < 2 and i < 4096:
+                v = f"chaos/{tag}/batch/{s}/{i}".encode()
+                i += 1
+                if shard_of(v) == s:
+                    candidates.append(v)
+                    picked += 1
+        forbidden = {route_bucket(v) for v in self._written}
+        forbidden.add(route_bucket(b"chaos/once"))
+        assign = {}
+        for v in candidates:
+            b = route_bucket(v)
+            if b in forbidden:
+                continue
+            assign[b] = (owner[b] + 1) % nsh
+        # Issued through the autopilot's linearized builder, so a
+        # concurrently in-flight migration can neither mint the same
+        # epoch nor lose its moves to this table.
+        rt = ap.issue_table(assign, dual=False)
+        ap.suppressed.add(target)
+        try:
+            ap.distribute(rt)
+            self.traffic(tag)
+            self._observe_window(step, seq0)
+        finally:
+            ap.suppressed.discard(target)
+            ap.distribute(rt)  # heal: the held-back member catches up
 
     def run(
         self,
@@ -631,7 +783,19 @@ class Nemesis:
         self.registry.arm(self.seed)
         self.detection = []  # a re-run must not inherit stale verdicts
         self.gray_blocked = []
+        self._migration = None
         self.collector = self._make_collector() if detect else None
+        self.autopilot = None
+        if self._want_autopilot:
+            from bftkv_tpu.autopilot import Autopilot
+
+            self.autopilot = Autopilot.for_cluster(
+                self.cluster, collector=self.collector
+            )
+        epoch_of = getattr(
+            self._client(0).qs, "route_epoch", lambda: 0
+        )
+        epoch_before = epoch_of()
         try:
             if self.collector is not None:
                 # Baseline scrape: counter-delta anomalies measure from
@@ -643,8 +807,27 @@ class Nemesis:
             cl.write_once(once_var, once_val)
             self.cluster.recorder.write_once_ok("u01", once_var, once_val)
             self.traffic("baseline")
-            for step in plan:
+            mig_thread: threading.Thread | None = None
+            for i, step in enumerate(plan):
+                if (
+                    self.autopilot is not None
+                    and mig_thread is None
+                    and i >= len(plan) // 2
+                    and self._client(0).qs.shard_count() > 1
+                ):
+                    # ONE forced hot-shard migration, paced so the
+                    # remaining fault steps land INSIDE the pre-copy /
+                    # flip / drain phases — reconfiguration under
+                    # chaos is the thing under test.
+                    mig_thread = threading.Thread(
+                        target=self._forced_migration, daemon=True
+                    )
+                    mig_thread.start()
                 self.run_step(step, dwell=dwell)
+            if mig_thread is not None:
+                mig_thread.join(timeout=240)
+                if mig_thread.is_alive():
+                    self._migration = {"ok": False, "error": "timeout"}
             self.traffic("final")
             try:
                 self.cluster.recorder.read_ok(
@@ -663,7 +846,9 @@ class Nemesis:
                 drain = getattr(cl, "drain_tails", None)
                 if drain is not None:
                     drain()
-            converged = self.converge()
+            converged = self.converge(
+                max_rounds=10 if self.autopilot is not None else 6
+            )
             trace = self.registry.trace()
             if self.collector is not None:
                 # Post-repair scrape: restarted members flip back to up
@@ -672,17 +857,41 @@ class Nemesis:
         finally:
             self.registry.disarm()
         shard_map = self.cluster.shard_map()
+        epoch_after = epoch_of()
+        routing_changed = epoch_after != epoch_before
         checker = SafetyChecker(
             self.cluster.recorder,
             f=self.cluster.f,
             shard_of_node=shard_map,
-            routing_stable=(shard_map == shard_map_before),
+            # Strict one-shard-per-variable only when NOTHING rerouted
+            # the keyspace: same seats AND same route epoch.  An epoch
+            # change legitimately migrates certified history between
+            # cliques (invariant 5's weak form still applies).
+            routing_stable=(
+                shard_map == shard_map_before and not routing_changed
+            ),
+            routing_changed=routing_changed,
         )
         replicas = self.cluster.storage_servers or self.cluster.servers
         violations = checker.check(replicas)
+        # Retirement acceptance is a recorded-history check, not mere
+        # absence of errors: every certified record the migrated
+        # buckets held must be readable from the new owners.
+        if self._migration is not None and not self._migration.get("ok"):
+            violations = violations + [
+                f"autopilot migration failed: {self._migration}"
+            ]
+        autopilot_doc = None
+        if self.autopilot is not None:
+            autopilot_doc = {
+                "migration": self._migration,
+                "status": self.autopilot.status(),
+            }
         return {
             "seed": self.seed,
             "shards": len(set(shard_map.values())) if shard_map else 1,
+            "route_epoch": epoch_after,
+            "autopilot": autopilot_doc,
             "plan": plan,
             "converged": converged,
             "faults_fired": len(trace),
@@ -735,7 +944,16 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--kinds", default="",
                     help="comma-separated step-kind pool override "
                          "(e.g. a slow_node-heavy soak: "
-                         "--kinds slow_node,link_delay,crash_restart)")
+                         "--kinds slow_node,link_delay,crash_restart; "
+                         "route_flap needs --autopilot and --shards 2+)")
+    ap.add_argument("--autopilot", action="store_true",
+                    help="run the topology autopilot against the "
+                         "cluster: one forced hot-shard migration "
+                         "executes WHILE the second half of the fault "
+                         "schedule lands (pre-copy / flip / drain under "
+                         "chaos), crash-restarted replicas are "
+                         "re-delivered the current route table, and "
+                         "the route_flap kind becomes available")
     args = ap.parse_args(argv)
 
     kinds = tuple(
@@ -743,13 +961,19 @@ def main(argv: list[str] | None = None) -> int:
     ) or None
     if kinds and any(k not in STEP_KINDS for k in kinds):
         ap.error(f"--kinds must draw from {STEP_KINDS}")
+    if kinds and "route_flap" in kinds and not (
+        args.autopilot and args.shards > 1
+    ):
+        ap.error("--kinds route_flap needs --autopilot and --shards 2+")
 
     cluster = build_cluster(
         args.servers, 1, args.rw, bits=args.bits, n_shards=args.shards,
         n_gateways=args.gateways,
     )
     try:
-        report = Nemesis(cluster, seed=args.seed).run(
+        report = Nemesis(
+            cluster, seed=args.seed, autopilot=args.autopilot
+        ).run(
             steps=args.steps, dwell=args.dwell,
             detect=not args.no_detect, kinds=kinds,
         )
@@ -772,6 +996,21 @@ def main(argv: list[str] | None = None) -> int:
         f"failures={report['failures']} converged={report['converged']} "
         f"detected={len(detected)}/{len(report['detection'])}"
     )
+    if report.get("autopilot"):
+        mig = report["autopilot"]["migration"]
+        print(
+            f"autopilot: route epoch {report['route_epoch']} · "
+            + (
+                "no migration ran"
+                if mig is None
+                else (
+                    f"{mig.get('kind', '?')} shard {mig.get('shard')} → "
+                    f"{mig.get('targets')} "
+                    f"({mig.get('buckets')} buckets) "
+                    + ("ok" if mig.get("ok") else "FAILED")
+                )
+            )
+        )
     for v in report["violations"]:
         print(f"VIOLATION: {v}")
     for d in report["undetected"]:

@@ -228,9 +228,35 @@ class Gateway:
                 raise ERR_UNCERTIFIED_RECORD
             qa = qm.choose_quorum_for(self.qs, variable, qm.AUTH)
             with trace.span("gateway.verify_fill"):
-                self.crypt.collective.verify(
-                    pkt.tbss(raw), p.ss, qa, self.crypt.keyring
-                )
+                try:
+                    self.crypt.collective.verify(
+                        pkt.tbss(raw), p.ss, qa, self.crypt.keyring
+                    )
+                except Exception:
+                    # Dual-epoch migration window (DESIGN.md §15): a
+                    # record the OLD owner clique certified while it
+                    # owned the bucket is still a sound fill — retry
+                    # against the dual quorum the route table names.
+                    # Outside a window alt_quorums_for is empty and the
+                    # failure stands (the Byzantine-fill signal).
+                    alts = getattr(
+                        self.qs, "alt_quorums_for", lambda *_a: []
+                    )(variable, qm.AUTH)
+                    if not alts:
+                        raise
+                    err = None
+                    for alt in alts:
+                        try:
+                            self.crypt.collective.verify(
+                                pkt.tbss(raw), p.ss, alt,
+                                self.crypt.keyring,
+                            )
+                            err = None
+                            break
+                        except Exception as e:
+                            err = e
+                    if err is not None:
+                        raise err
         except Exception:
             self._verify_fails += 1
             metrics.incr("gateway.cache.verify_fail")

@@ -73,6 +73,12 @@ ANOMALY_COUNTERS = {
     # verification against the owner quorum: someone fed the gateway a
     # record the quorum never endorsed — the Byzantine-fill signal.
     "gateway.cache.verify_fail": "gateway_poisoned_fill",
+    # Epoched routing (DESIGN.md §15): a replica declined a request
+    # for a bucket an epoch flip moved away from it — some client is
+    # still routing on an older epoch (the route_flap fault's shape;
+    # benign in small bursts around a flip, sustained means a member
+    # never received the new table).
+    "server.epoch_stale": "epoch_skew",
 }
 
 
@@ -159,6 +165,10 @@ class FleetCollector:
         self._exemplars: dict = {}  # shard -> deque of slow entries
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
+        #: Optional zero-arg callable set by an attached topology
+        #: autopilot; its status rides the health document so /fleet
+        #: reports the last decision next to the budgets it came from.
+        self.autopilot_status = None
 
     # -- anomaly feed ------------------------------------------------------
 
@@ -530,6 +540,9 @@ class FleetCollector:
                         "last_ok_age_s": round(now - m.last_ok, 1)
                         if m.last_ok
                         else None,
+                        # Route-table epoch the member self-reports; a
+                        # fleet mid-flip shows a mixed column here.
+                        "epoch": m.info.get("epoch"),
                     }
                     for n, m in sorted(members)
                 ],
@@ -557,6 +570,23 @@ class FleetCollector:
             shards_doc[str(sh)] = doc
 
         up = [n for n, m in all_members.items() if m.status == "up"]
+        # Fleet-wide epoch spread: every member's self-reported
+        # route-table epoch (None = never answered /info or pre-epoch
+        # daemon).  min != max while a flip is propagating.
+        epochs = sorted(
+            {
+                m.info.get("epoch")
+                for m in all_members.values()
+                if isinstance(m.info.get("epoch"), int)
+            }
+        )
+        autopilot = None
+        status_fn = self.autopilot_status
+        if callable(status_fn):
+            try:
+                autopilot = status_fn()
+            except Exception:
+                autopilot = None
         with self._lock:
             anomalies = list(self._anomalies)[-200:]
             scrapes = self._scrapes
@@ -574,7 +604,13 @@ class FleetCollector:
                 "unseated": sorted(
                     n for n, m in all_members.items() if not m.info
                 ),
+                "route_epochs": {
+                    "min": epochs[0] if epochs else None,
+                    "max": epochs[-1] if epochs else None,
+                    "skewed": len(epochs) > 1,
+                },
             },
+            "autopilot": autopilot,
             "shards": shards_doc,
             "gateways": self._gateways(all_members, now),
             "traces": {
@@ -608,6 +644,11 @@ class FleetCollector:
         add("daemons", "gauge", "", str(doc["fleet"]["daemons"]))
         add("daemons_up", "gauge", "", str(doc["fleet"]["up"]))
         add("scrapes", "gauge", "", str(doc["scrapes"]))
+        repochs = doc["fleet"].get("route_epochs") or {}
+        if isinstance(repochs.get("max"), int):
+            add("route_epoch", "gauge", "", str(repochs["max"]))
+            add("route_epoch_skewed", "gauge", "",
+                "1" if repochs.get("skewed") else "0")
         gws = doc.get("gateways") or {}
         if gws:
             add("gateways", "gauge", "", str(len(gws)))

@@ -35,6 +35,7 @@ from bftkv_tpu.crypto import vcache
 from bftkv_tpu.crypto.threshold import ThresholdAlgo, serialize_params
 from bftkv_tpu.errors import (
     error_from_string,
+    parse_wrong_shard,
     ERR_CONTINUE,
     ERR_INSUFFICIENT_NUMBER_OF_QUORUM,
     ERR_INSUFFICIENT_NUMBER_OF_RESPONSES,
@@ -509,31 +510,75 @@ class Client(Protocol):
                     return
                 except _PiggybackFallback:
                     metrics.incr("client.piggyback.fallback")
-            with trace.span("quorum.select"):
-                qr = qm.choose_quorum_for(self.qs, variable, qm.READ | qm.AUTH)
-            maxt = 0
-            actives: list = []
-            failure: list = []
-
-            def cb(res: tp.MulticastResponse) -> bool:
-                nonlocal maxt
-                if res.err is None and res.data and len(res.data) <= 8:
-                    t = int.from_bytes(res.data, "big")
-                    if t > maxt:
-                        maxt = t
-                    actives.append(res.peer)
-                    return qr.is_threshold(actives)
-                failure.append(res.peer)
-                return qr.reject(failure)
-
-            with trace.span("phase.time", attrs={"peers": len(qr.nodes())}):
-                self.tr.multicast(tp.TIME, qr.nodes(), variable, cb)
-            if not qr.is_threshold(actives):
-                raise ERR_INSUFFICIENT_NUMBER_OF_QUORUM
-            if maxt == MAX_UINT64:
-                raise ERR_INVALID_TIMESTAMP
-            self._write_with_timestamp(variable, value, maxt + 1, proof)
+            self._with_reroute(
+                variable,
+                lambda: self._write_classic(variable, value, proof),
+            )
             metrics.incr("client.write.ok")
+
+    # -- epoched-routing decline hints (DESIGN.md §15) ---------------------
+
+    def _note_route_hint(self, variable: bytes, epoch, owner) -> bool:
+        """Adopt a wrong-shard decline's routing hint: bucket ``x`` is
+        owned by shard ``owner`` as of the responder's ``epoch``.  Only
+        newer-than-installed epochs stick (quorum-system rule), so a
+        Byzantine decline can cost at most one wasted re-route."""
+        note = getattr(self.qs, "note_route_hint", None)
+        if note is None or epoch is None or owner is None:
+            return False
+        return note(variable, epoch, owner)
+
+    def _with_reroute(self, variable: bytes, fn):
+        """Run one classic-path round sequence, re-routing ONCE when
+        the quorum's majority answer is a wrong-shard decline carrying
+        a routing hint — the stale-route client's refetch-and-retry:
+        the hint re-aims ``choose_quorum_for`` at the owning clique and
+        the sequence re-runs there."""
+        try:
+            return fn()
+        except Exception as e:
+            ws = parse_wrong_shard(e)
+            if ws is None:
+                raise
+            # The hint may be a no-op (our own table advanced mid-round
+            # past the responder's epoch) — the retry below still runs
+            # on the CURRENT route, which is exactly the fix then.
+            self._note_route_hint(variable, ws[0], ws[1])
+            metrics.incr("client.route.rerouted")
+            return fn()
+
+    def _write_classic(self, variable: bytes, value: bytes, proof) -> None:
+        """The classic three rounds: TIME below, then sign + write."""
+        with trace.span("quorum.select"):
+            qr = qm.choose_quorum_for(self.qs, variable, qm.READ | qm.AUTH)
+        maxt = 0
+        actives: list = []
+        failure: list = []
+        errs: list = []
+
+        def cb(res: tp.MulticastResponse) -> bool:
+            nonlocal maxt
+            if res.err is None and res.data and len(res.data) <= 8:
+                t = int.from_bytes(res.data, "big")
+                if t > maxt:
+                    maxt = t
+                actives.append(res.peer)
+                return qr.is_threshold(actives)
+            if res.err is not None:
+                errs.append(res.err)
+            failure.append(res.peer)
+            return qr.reject(failure)
+
+        with trace.span("phase.time", attrs={"peers": len(qr.nodes())}):
+            self.tr.multicast(tp.TIME, qr.nodes(), variable, cb)
+        if not qr.is_threshold(actives):
+            # The majority failure (e.g. a hinted wrong-shard decline
+            # after an epoch flip) must surface — the reroute wrapper
+            # reads the hint off it.
+            raise majority_error(errs, ERR_INSUFFICIENT_NUMBER_OF_QUORUM)
+        if maxt == MAX_UINT64:
+            raise ERR_INVALID_TIMESTAMP
+        self._write_with_timestamp(variable, value, maxt + 1, proof)
 
     def write_once(self, variable: bytes, value: bytes, proof=None) -> None:
         """t = 2^64-1 marks the value immutable forever
@@ -549,7 +594,12 @@ class Client(Protocol):
                 return
             except _PiggybackFallback:
                 metrics.incr("client.piggyback.fallback")
-        self._write_with_timestamp(variable, value, MAX_UINT64, proof)
+        self._with_reroute(
+            variable,
+            lambda: self._write_with_timestamp(
+                variable, value, MAX_UINT64, proof
+            ),
+        )
 
     def _write_with_timestamp(
         self, variable: bytes, value: bytes, t: int, proof
@@ -679,6 +729,14 @@ class Client(Protocol):
                 metrics.incr("client.piggyback.ok")
                 self._presession.lease_update(variable, t)
                 return
+            if status == "reroute":
+                # Wrong-shard decline with a NEWER-epoch hint: the hint
+                # is noted in the quorum system, so the retry below
+                # re-routes this round to the owning clique.  The lease
+                # may be aimed at the old owner's history — the new
+                # owner's decline-hint loop re-seats it if stale.
+                metrics.incr("client.route.rerouted")
+                continue
             if status == "retry" and t_fixed is None:
                 # Stale lease: the quorum answered with its stored
                 # timestamps; retry ONE past the highest.  This in-round
@@ -761,6 +819,7 @@ class Client(Protocol):
         fails: list = []
         errs: list = []
         hints: list[int] = []
+        shard_hints: list[tuple[int, int]] = []  # (epoch, owner) declines
         legacy: list = []
 
         def add_share(share_bytes: bytes) -> None:
@@ -819,6 +878,11 @@ class Client(Protocol):
             if err == ERR_UNKNOWN_COMMAND:
                 legacy.append(res.peer)
                 self._legacy_peers.add(res.peer.id)
+            ws = parse_wrong_shard(err)
+            if ws is not None and ws[1] is not None:
+                # Epoched wrong-shard decline: the responder told us
+                # its epoch and the owning shard — reroute in-round.
+                shard_hints.append(ws)
             errs.append(err)
             fails.append(res.peer)
             return False
@@ -858,6 +922,14 @@ class Client(Protocol):
         if not committed():
             if legacy:
                 return ("fallback", None)
+            if shard_hints:
+                # Reroute even when the hint is a no-op (our table may
+                # have advanced past the responder's epoch mid-round) —
+                # the retry re-selects on the CURRENT route either way,
+                # and the attempt budget bounds Byzantine decline spam.
+                epoch, owner = max(shard_hints)
+                self._note_route_hint(variable, epoch, owner)
+                return ("reroute", (epoch, owner))
             if hints:
                 return ("retry", max(hints))
             return (
@@ -1340,7 +1412,7 @@ class Client(Protocol):
             resolved: list[tuple[bytes | None, int] | None] = [None] * n
             try:
                 resolved = self._resolve_complete_fanout_many(
-                    ms, q, key=variables[0]
+                    ms, q, key=variables[0], keys=variables
                 )
                 self._certify_resolved(ms, q, resolved, variables, proof)
             except Exception as e:
@@ -1668,7 +1740,11 @@ class Client(Protocol):
         raise _InProgress
 
     def _resolve_complete_fanout_many(
-        self, ms: list[dict], q, key: bytes | None = None
+        self,
+        ms: list[dict],
+        q,
+        key: bytes | None = None,
+        keys: list | None = None,
     ) -> list[tuple[bytes | None, int] | None]:
         """Complete-fan-out fallback for a list of response maps,
         timestamps descending per item: a bucket wins by responder
@@ -1726,6 +1802,38 @@ class Client(Protocol):
                 errs = self.crypt.collective.verify_many(
                     jobs, qa, self.crypt.keyring
                 )
+                # Dual-epoch admission window (DESIGN.md §15): a record
+                # certified by the OLD owner clique is still readable
+                # mid-migration — retry each failure against the dual
+                # quorum(s) the route table names for THAT item's own
+                # bucket (a batch groups by owner shard, but only some
+                # of its buckets may be inside a window).  Outside a
+                # window alt_quorums_for is empty and nothing changes.
+                if any(e is not None for e in errs):
+                    alt_of = getattr(
+                        self.qs, "alt_quorums_for", lambda *_a: []
+                    )
+                    for i, e in enumerate(errs):
+                        if e is None:
+                            continue
+                        k = meta[i][0]
+                        item_key = (
+                            keys[k]
+                            if keys is not None and k < len(keys)
+                            else key
+                        )
+                        for alt in alt_of(item_key or b"", qm.AUTH):
+                            try:
+                                self.crypt.collective.verify(
+                                    jobs[i][0],
+                                    jobs[i][1],
+                                    alt,
+                                    self.crypt.keyring,
+                                )
+                                errs[i] = None
+                                break
+                            except Exception:
+                                continue
             except Exception:
                 # Verification machinery failing must not discard the
                 # threshold resolutions already computed above — those

@@ -42,7 +42,7 @@ from bftkv_tpu.crypto import auth as authmod
 from bftkv_tpu.crypto import cert as certmod
 from bftkv_tpu.crypto import signature as sigmod
 from bftkv_tpu.crypto import vcache
-from bftkv_tpu.errors import error_from_string
+from bftkv_tpu.errors import error_from_string, wrong_shard_error
 from bftkv_tpu.faults import failpoint as fp
 from bftkv_tpu.errors import (
     ERR_AUTHENTICATION_FAILURE,
@@ -261,32 +261,96 @@ class Server(Protocol):
 
     # -- keyspace sharding admission gate ---------------------------------
 
-    def _shard_check(self, variable: bytes) -> None:
-        """Reject data-plane requests for variables this replica's
-        shard does not own.  On unsharded trust graphs (and for quorum
-        systems without keyed routing) this is a no-op, so single-clique
-        clusters behave bit-for-bit as before.  The gate is what makes
-        cross-shard collective signatures unmintable: the only replicas
-        that will sign or store <x,...> are the owner clique's, so a
-        signature gathered anywhere else can never reach the owner
-        quorum's threshold."""
-        owns = getattr(self.qs, "owns", None)
-        if owns is not None and not owns(variable):
-            # Labeled by the shard THIS replica serves (a closed enum:
-            # shard indices, bounded by the clique count) — the fleet
-            # collector's anomaly feed attributes misroutes per shard.
-            # Unlabeled when the seat is momentarily unknown (topology
-            # regenerating): a string fallback under the same name
-            # would make Prometheus' sorted() comparison of int and
-            # str label values raise.
-            my_shard = getattr(self.qs, "my_shard", lambda: None)()
-            metrics.incr(
-                "server.wrong_shard",
-                labels=(
-                    {"shard": my_shard} if my_shard is not None else None
-                ),
-            )
-            raise ERR_WRONG_SHARD
+    def _wrong_shard(self, variable: bytes, stale: bool = False) -> None:
+        """Count and raise the wrong-shard decline.  With an installed
+        route epoch the decline carries the responder's epoch and the
+        owning shard index so a stale-route client re-routes in-round;
+        epoch-0 fleets (and non-epoched quorum systems) keep raising
+        the bare interned form legacy clients already understand.
+        ``stale``: the misroute looks stale-ROUTED (an epoch flip moved
+        the bucket away from here) rather than Byzantine — the
+        ``server.epoch_stale`` counter feeds the fleet collector's
+        ``epoch_skew`` anomaly."""
+        qs = self.qs
+        # Labeled by the shard THIS replica serves (a closed enum:
+        # shard indices, bounded by the clique count) — the fleet
+        # collector's anomaly feed attributes misroutes per shard.
+        # Unlabeled when the seat is momentarily unknown (topology
+        # regenerating): a string fallback under the same name
+        # would make Prometheus' sorted() comparison of int and
+        # str label values raise.
+        my_shard = getattr(qs, "my_shard", lambda: None)()
+        labels = {"shard": my_shard} if my_shard is not None else None
+        metrics.incr("server.wrong_shard", labels=labels)
+        if stale:
+            metrics.incr("server.epoch_stale", labels=labels)
+        hint = getattr(qs, "route_hint", None)
+        if (
+            hint is not None
+            and getattr(qs, "route_epoch", lambda: 0)() > 0
+        ):
+            epoch, owner = hint(variable)
+            if owner is not None:
+                raise wrong_shard_error(epoch, owner)
+        raise ERR_WRONG_SHARD
+
+    def _shard_check(self, variable: bytes, write: bool = True) -> str:
+        """Admission gate for keyspace routing; returns this replica's
+        role for ``variable`` (``owner`` / ``dual`` / ``foreign``).
+
+        On unsharded trust graphs (and for quorum systems without keyed
+        routing) this is a no-op, so single-clique clusters behave
+        bit-for-bit as before.  The gate is what makes cross-shard
+        collective signatures unmintable: the only replicas that will
+        sign or store <x,...> are the owner clique's, so a signature
+        gathered anywhere else can never reach the owner quorum's
+        threshold.
+
+        Epoched routing refines the gate (DESIGN.md §15):
+
+        - a ``dual`` replica (old owner inside the dual-epoch window)
+          passes here; the write-path handlers then restrict it to
+          versions it ALREADY stored (``_dual_write_ok``) — it keeps
+          serving and certifying, it never mints a new version, so the
+          new owner stays the single write serializer and invariant 5
+          survives the flip;
+        - ``foreign`` READS are served (not declined) once an epoch is
+          installed — the inert-stale-copy rule: a replica straddling a
+          flip keeps serving what it has while refusing new writes for
+          buckets it no longer owns."""
+        qs = self.qs
+        role_of = getattr(qs, "route_role", None)
+        if role_of is None:
+            owns = getattr(qs, "owns", None)
+            if owns is not None and not owns(variable):
+                self._wrong_shard(variable)
+            return "owner"
+        role = role_of(variable)
+        if role == "foreign":
+            if (
+                not write
+                and getattr(qs, "route_epoch", lambda: 0)() > 0
+            ):
+                metrics.incr("server.read.foreign")
+                return role
+            stale = getattr(qs, "stale_routed", lambda _x: False)
+            self._wrong_shard(variable, stale=stale(variable))
+        return role
+
+    def _dual_write_ok(self, variable: bytes, t: int, val) -> bool:
+        """What a dual-window (old owner) replica may still admit on
+        the write plane: exactly the versions it already stored — the
+        back-fill / certify / idempotent-retry shapes of in-flight
+        writes that started before the flip.  Anything NEW must go to
+        the new owner (the decline hint sends the client there)."""
+        try:
+            vt = self.storage.read(variable, t)
+        except Exception:
+            return False
+        try:
+            return pkt.parse(vt).value == val
+        except Exception:
+            return False
 
     # -- membership (reference: server.go:64-120) -------------------------
 
@@ -328,7 +392,10 @@ class Server(Protocol):
         variable = req
         if variable.startswith(HIDDEN_PREFIX):
             raise ERR_PERMISSION_DENIED
-        self._shard_check(variable)
+        if self._shard_check(variable) == "dual":
+            # A TIME answer would keep a stale classic writer minting
+            # NEW versions at the old owner — send it to the new one.
+            self._wrong_shard(variable, stale=True)
         t = 0
         try:
             raw = self.storage.read(variable, 0)
@@ -365,7 +432,7 @@ class Server(Protocol):
     ) -> bytes | None:
         if variable.startswith(HIDDEN_PREFIX):
             raise ERR_PERMISSION_DENIED
-        self._shard_check(variable)
+        self._shard_check(variable, write=False)
         raw = None
         authenticated = None
         try:
@@ -459,7 +526,11 @@ class Server(Protocol):
         # stored there by _distribute.
         if variable.startswith(HIDDEN_PREFIX):
             raise ERR_PERMISSION_DENIED
-        self._shard_check(variable)
+        if (
+            self._shard_check(variable) == "dual"
+            and not self._dual_write_ok(variable, t, val)
+        ):
+            self._wrong_shard(variable, stale=True)
 
         # Verify the writer's signature with its own certificate.
         issuer = sigmod.issuer(sig, self.crypt.keyring)
@@ -624,7 +695,9 @@ class Server(Protocol):
             raise ERR_MALFORMED_REQUEST
         if variable.startswith(HIDDEN_PREFIX):
             raise ERR_PERMISSION_DENIED
-        self._shard_check(variable)
+        role = self._shard_check(variable)
+        if role == "dual" and not self._dual_write_ok(variable, t, val):
+            self._wrong_shard(variable, stale=True)
 
         # Sufficient quorum members must have signed the same <x,v,t> —
         # against the OWNER shard's quorum, so a collective signature
@@ -764,7 +837,12 @@ class Server(Protocol):
         nodes ack without a share: their signatures could never count
         toward ``suff`` anyway (is_sufficient tallies clique members
         only), and skipping the private-key op keeps the write plane as
-        cheap as the legacy WRITE round."""
+        cheap as the legacy WRITE round.  Epoched quorum systems answer
+        directly (``WotQS.signs_for``) — a dual-window old owner keeps
+        a sign seat for versions it already stored."""
+        fn = getattr(self.qs, "signs_for", None)
+        if fn is not None:
+            return fn(variable)
         qa = qm.choose_quorum_for(self.qs, variable, qm.AUTH)
         myid = self.self_node.get_self_id()
         return any(n.id == myid for n in qa.nodes())
@@ -794,7 +872,15 @@ class Server(Protocol):
             raise ERR_MALFORMED_REQUEST
         if variable.startswith(HIDDEN_PREFIX):
             raise ERR_PERMISSION_DENIED
-        self._shard_check(variable)
+        if (
+            self._shard_check(variable) == "dual"
+            and not self._dual_write_ok(variable, t, val)
+        ):
+            # The dual window keeps in-flight tails alive (re-acks and
+            # certifications of versions this replica already stored);
+            # a NEW version must mint at the new owner — the hinted
+            # decline re-routes the writer in-round.
+            self._wrong_shard(variable, stale=True)
 
         # Writer authentication, exactly as the sign phase does it.
         issuer = sigmod.issuer(sig, self.crypt.keyring)
@@ -991,7 +1077,8 @@ class Server(Protocol):
             raise ERR_MALFORMED_REQUEST
         if variable.startswith(HIDDEN_PREFIX):
             raise ERR_PERMISSION_DENIED
-        self._shard_check(variable)
+        if self._shard_check(variable) == "dual":
+            self._wrong_shard(variable, stale=True)
         # Do NOT verify the signature here — it is kept with the auth
         # data for future use (reference: server.go:385).
         try:
@@ -1133,7 +1220,8 @@ class Server(Protocol):
             raise ERR_MALFORMED_REQUEST
         if variable.startswith(HIDDEN_PREFIX):
             raise ERR_PERMISSION_DENIED
-        self._shard_check(variable)
+        if self._shard_check(variable) == "dual":
+            self._wrong_shard(variable, stale=True)
 
         issuer = sigmod.issuer(sig, self.crypt.keyring)
         tbs = pkt.tbs(req)
@@ -1337,7 +1425,12 @@ class Server(Protocol):
                     raise ERR_MALFORMED_REQUEST
                 if (p.variable or b"").startswith(HIDDEN_PREFIX):
                     raise ERR_PERMISSION_DENIED
-                self._shard_check(p.variable or b"")
+                if self._shard_check(
+                    p.variable or b""
+                ) == "dual" and not self._dual_write_ok(
+                    p.variable or b"", p.t, p.value
+                ):
+                    self._wrong_shard(p.variable or b"", stale=True)
                 packets[i] = p
             except Exception as e:
                 results[i] = (_errstr(e), b"")
@@ -1501,7 +1594,10 @@ class Server(Protocol):
                     raise ERR_MALFORMED_REQUEST
                 if variable.startswith(HIDDEN_PREFIX):
                     raise ERR_PERMISSION_DENIED
-                self._shard_check(variable)
+                if self._shard_check(variable) == "dual" and not (
+                    self._dual_write_ok(variable, p.t, p.value)
+                ):
+                    self._wrong_shard(variable, stale=True)
                 parsed[i] = (p, r)
                 jobs.append((pkt.tbss(r), ss))
                 jidx.append(i)

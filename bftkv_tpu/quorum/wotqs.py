@@ -27,6 +27,7 @@ batched device tallies in ``bftkv_tpu.ops.tally`` for bulk paths
 from __future__ import annotations
 
 import hashlib
+import struct
 import threading
 from dataclasses import dataclass, field
 
@@ -46,6 +47,132 @@ ROUTE_BUCKETS = 256
 def route_bucket(x: bytes) -> int:
     """The routing bucket of a variable name."""
     return hashlib.sha256(x).digest()[0]
+
+
+class RouteTable:
+    """One epoch of the versioned route table (DESIGN.md §15).
+
+    Epoch 0 is implicit: the pure HRW table every view derives from the
+    certificate-borne clique set (no RouteTable object exists).  An
+    installed table (epoch ≥ 1) overrides bucket ownership — the
+    topology autopilot's split / retire plans are exactly such tables.
+
+    Shards are identified by **clique id** (the smallest member id of
+    the clique), never by positional index: a table must keep meaning
+    the same thing across graph generations, and after a retirement the
+    dissolved clique's index disappears while its id never re-binds.
+    ``table[b]`` / ``dual[b]`` index into ``cliques``.
+
+    ``dual`` is the dual-epoch admission window: for a moving bucket it
+    names the OLD owner clique, which may keep serving reads, accepting
+    certifications of versions it already stored (echoes, back-fills,
+    sync), and syncing — but never mints NEW versions (the new owner is
+    the single write serializer, so invariant 5 survives the flip).
+    ``retiring`` marks cliques being drained; a well-formed table routes
+    no bucket to them.
+
+    The table is signed (detached, over :meth:`payload`) by the issuing
+    principal.  Routing is a LIVENESS surface, not a safety one — a
+    forged table can misroute a client, whose writes then die in the
+    honest owner's admission gate and reroute off the decline hint —
+    but verification keeps a compromised distributor from silently
+    degrading a fleet, so installs may demand it."""
+
+    __slots__ = ("epoch", "cliques", "table", "dual", "retiring",
+                 "issuer", "sig")
+
+    def __init__(self, epoch, cliques, table, dual=None, retiring=(),
+                 issuer=0, sig=b""):
+        self.epoch = int(epoch)
+        self.cliques = tuple(int(c) for c in cliques)
+        self.table = tuple(int(i) for i in table)
+        self.dual = {int(b): int(i) for b, i in (dual or {}).items()}
+        self.retiring = frozenset(int(i) for i in retiring)
+        self.issuer = int(issuer)
+        self.sig = bytes(sig)
+        if len(self.table) != ROUTE_BUCKETS:
+            raise ValueError("route table must cover every bucket")
+        if any(i >= len(self.cliques) for i in self.table):
+            raise ValueError("route entry names an unknown clique")
+
+    def payload(self) -> bytes:
+        """Canonical signed bytes: everything but issuer/sig."""
+        out = [b"rt1", struct.pack(">QH", self.epoch, len(self.cliques))]
+        out += [struct.pack(">Q", c) for c in self.cliques]
+        out.append(bytes(self.table))
+        out.append(struct.pack(">H", len(self.dual)))
+        for b in sorted(self.dual):
+            out.append(struct.pack(">BB", b, self.dual[b]))
+        out.append(struct.pack(">B", len(self.retiring)))
+        out += [struct.pack(">B", i) for i in sorted(self.retiring)]
+        return b"".join(out)
+
+    def serialize(self) -> bytes:
+        p = self.payload()
+        return p + struct.pack(">QH", self.issuer, len(self.sig)) + self.sig
+
+    @classmethod
+    def parse(cls, data: bytes) -> "RouteTable":
+        try:
+            return cls._parse(data)
+        except ValueError:
+            raise
+        except Exception as e:
+            # Hostile-input contract: truncated / huge-count / garbage
+            # bytes reject as ValueError, never as a struct/index
+            # internals leak.
+            raise ValueError(f"malformed route table: {e}") from None
+
+    @classmethod
+    def _parse(cls, data: bytes) -> "RouteTable":
+        if data[:3] != b"rt1":
+            raise ValueError("not a route table")
+        off = 3
+        epoch, nclique = struct.unpack_from(">QH", data, off)
+        off += 10
+        cliques = struct.unpack_from(">" + "Q" * nclique, data, off)
+        off += 8 * nclique
+        table = data[off:off + ROUTE_BUCKETS]
+        off += ROUTE_BUCKETS
+        (ndual,) = struct.unpack_from(">H", data, off)
+        off += 2
+        dual = {}
+        for _ in range(ndual):
+            b, i = struct.unpack_from(">BB", data, off)
+            off += 2
+            dual[b] = i
+        (nret,) = struct.unpack_from(">B", data, off)
+        off += 1
+        retiring = struct.unpack_from(">" + "B" * nret, data, off)
+        off += nret
+        issuer, siglen = struct.unpack_from(">QH", data, off)
+        off += 10
+        sig = data[off:off + siglen]
+        return cls(epoch, cliques, table, dual, retiring, issuer, sig)
+
+    def sign(self, key, cert) -> "RouteTable":
+        """Detached signature by ``cert``'s principal (RSA or P-256 —
+        the same algorithms certificate edges use)."""
+        from bftkv_tpu.crypto import cert as certmod
+        from bftkv_tpu.crypto import ecdsa as _ecdsa
+        from bftkv_tpu.crypto import rsa as _rsa
+
+        self.issuer = cert.id
+        if certmod.is_ec(key):
+            self.sig = _ecdsa.sign(self.payload(), key)
+        else:
+            self.sig = _rsa.sign(self.payload(), key)
+        return self
+
+    def verify(self, keyring) -> bool:
+        """True iff the issuer is in ``keyring`` and the detached
+        signature verifies over :meth:`payload`."""
+        from bftkv_tpu.crypto import cert as certmod
+
+        signer = keyring.get(self.issuer)
+        if signer is None or not self.sig:
+            return False
+        return certmod.verify_detached(self.payload(), self.sig, signer)
 
 
 def _howmany(a: int, b: int) -> int:
@@ -257,6 +384,22 @@ class WotQS:
         self._topo_gen: int | None = None
         self._kcache: dict[tuple[int, int], WotQuorum] = {}
         self._kcache_gen: int | None = None
+        # Epoched routing (DESIGN.md §15):
+        #   _route       — installed RouteTable override (None = epoch 0,
+        #                  pure HRW);
+        #   _route_cache — (route, topo) -> resolved (owner[], dual{},
+        #                  retiring set) in TOPO-index space;
+        #   _hints       — client-side decline hints: bucket -> (epoch,
+        #                  owner idx), applied to ROUTING only (never to
+        #                  the admission gates — hints are liveness
+        #                  hints, not authenticated state).
+        self._route: RouteTable | None = None
+        self._route_cache: tuple | None = None
+        self._hints: dict[int, tuple[int, int]] = {}
+        # Per-bucket route load (client-side write/read selection
+        # counts) — the autopilot's hot-bucket signal.  Plain ints;
+        # racy increments only lose stats, never correctness.
+        self._bucket_load = [0] * ROUTE_BUCKETS
 
     def _new_qc(self, nodes: list, weight: int, rw: int) -> QC | None:
         if rw & q.PEER:
@@ -358,12 +501,276 @@ class WotQS:
                     self._topo_gen = gen
         return topo
 
+    # -- epoched route table (DESIGN.md §15) -------------------------------
+
+    def route_epoch(self) -> int:
+        """The installed route-table epoch (0 = pure HRW routing)."""
+        rt = self._route
+        return rt.epoch if rt is not None else 0
+
+    def route_table(self) -> RouteTable | None:
+        return self._route
+
+    def install_route_table(
+        self, rt: RouteTable, keyring=None
+    ) -> bool:
+        """Adopt ``rt`` if it is NEWER than the installed epoch (and,
+        when ``keyring`` is given, its signature verifies).  Returns
+        True when ``rt`` is now (or already was) the active epoch —
+        installs are idempotent, stale epochs are refused so a replayed
+        old table can never roll routing back."""
+        if keyring is not None and not rt.verify(keyring):
+            metrics.incr("quorum.route.bad_sig")
+            return False
+        with self._cache_lock:
+            cur = self._route
+            if cur is not None and rt.epoch <= cur.epoch:
+                if rt.epoch < cur.epoch:
+                    metrics.incr("quorum.route.stale_install")
+                return rt.epoch == cur.epoch
+            self._route = rt
+            self._route_cache = None
+            # Decline hints at or below the new epoch are superseded.
+            self._hints = {
+                b: h for b, h in self._hints.items() if h[0] > rt.epoch
+            }
+        metrics.incr("quorum.route.installs")
+        metrics.gauge("quorum.route.epoch", rt.epoch)
+        return True
+
+    def _routing(self, topo: _ShardTopo) -> tuple | None:
+        """The installed table resolved into TOPO-index space:
+        ``(owner[ROUTE_BUCKETS], dual {bucket: old idx}, retiring idx
+        set)``, or None when no table is installed / unsharded.  A
+        table entry naming a clique absent from the current topology
+        (retired and removed) falls back to the HRW owner."""
+        rt = self._route
+        if rt is None or len(topo.shards) <= 1:
+            return None
+        cached = self._route_cache
+        if (
+            cached is not None
+            and cached[0] is rt
+            and cached[1] is topo
+        ):
+            return cached[2]
+        cid_to_idx = {
+            min(n.id for n in c.nodes): i
+            for i, c in enumerate(topo.shards)
+        }
+        owner = list(topo.table)
+        dual: dict[int, int] = {}
+        retiring: set[int] = set()
+        for b in range(ROUTE_BUCKETS):
+            idx = cid_to_idx.get(rt.cliques[rt.table[b]])
+            if idx is not None:
+                owner[b] = idx
+        for b, old in rt.dual.items():
+            if old < len(rt.cliques) and 0 <= b < ROUTE_BUCKETS:
+                idx = cid_to_idx.get(rt.cliques[old])
+                if idx is not None and idx != owner[b]:
+                    dual[b] = idx
+        for i in rt.retiring:
+            if i < len(rt.cliques):
+                idx = cid_to_idx.get(rt.cliques[i])
+                if idx is not None:
+                    retiring.add(idx)
+        resolved = (owner, dual, retiring)
+        with self._cache_lock:
+            self._route_cache = (rt, topo, resolved)
+        return resolved
+
+    def _owner_idx(
+        self, b: int, topo: _ShardTopo, with_hints: bool = False
+    ) -> int | None:
+        """The shard index owning bucket ``b``: the installed table's
+        word, else HRW.  ``with_hints`` additionally applies newer-epoch
+        decline hints — ROUTING (client quorum selection) only; the
+        admission gates never consult hints."""
+        if not topo.table:
+            return None
+        r = self._routing(topo)
+        owner = r[0][b] if r is not None else topo.table[b]
+        if with_hints and self._hints:
+            h = self._hints.get(b)
+            if (
+                h is not None
+                and h[0] > self.route_epoch()
+                and 0 <= h[1] < len(topo.shards)
+            ):
+                owner = h[1]
+        return owner
+
+    def effective_route(self) -> list[int]:
+        """Owner shard index per bucket under the installed epoch (no
+        hints) — the autopilot's plan input."""
+        topo = self._topology()
+        if not topo.table:
+            return []
+        return [self._owner_idx(b, topo) for b in range(ROUTE_BUCKETS)]
+
+    def route_cliques(self) -> tuple[int, ...]:
+        """Clique ids (smallest member id) in shard-index order."""
+        topo = self._topology()
+        return tuple(min(n.id for n in c.nodes) for c in topo.shards)
+
+    def route_role(self, x: bytes) -> str:
+        """This node's relation to ``x`` under the installed epoch:
+        ``owner`` (full write admission), ``dual`` (old owner inside
+        the dual-epoch window: serve + certify stored versions, never
+        mint new ones), or ``foreign``.  Unsharded graphs and
+        unassigned principals are always ``owner``."""
+        topo = self._topology()
+        if len(topo.shards) <= 1:
+            return "owner"
+        mine = topo.shard_index_of(self.g.get_self_id())
+        if mine is None:
+            return "owner"
+        b = route_bucket(x)
+        if self._owner_idx(b, topo) == mine:
+            return "owner"
+        r = self._routing(topo)
+        if r is not None and r[1].get(b) == mine:
+            return "dual"
+        return "foreign"
+
+    def route_hint(self, x: bytes) -> tuple[int, int | None]:
+        """``(epoch, owner shard index)`` for a wrong-shard decline —
+        what a stale-routed client needs to re-route in-round."""
+        topo = self._topology()
+        if not topo.table:
+            return self.route_epoch(), None
+        return self.route_epoch(), self._owner_idx(route_bucket(x), topo)
+
+    def bucket_moved(self, x: bytes) -> bool:
+        """Whether ``x``'s bucket is owned by a different shard than
+        the pure-HRW (epoch-0) table would assign — i.e. some epoch
+        moved it.  The chaos checker uses this to widen its invariant-3
+        audit ONLY where migration can legitimately explain a foreign
+        clique's signature."""
+        topo = self._topology()
+        if not topo.table:
+            return False
+        b = route_bucket(x)
+        return self._owner_idx(b, topo) != topo.table[b]
+
+    def stale_routed(self, x: bytes) -> bool:
+        """Whether a misrouted request for ``x`` landing HERE looks
+        stale-ROUTED rather than Byzantine: an epoch override moved the
+        bucket away from this node's shard, which is exactly where an
+        epoch-N client would still send it."""
+        if self._route is None:
+            return False
+        topo = self._topology()
+        if len(topo.shards) <= 1 or not topo.table:
+            return False
+        mine = topo.shard_index_of(self.g.get_self_id())
+        if mine is None:
+            return False
+        b = route_bucket(x)
+        return topo.table[b] == mine and self._owner_idx(b, topo) != mine
+
+    def note_route_hint(self, x: bytes, epoch: int, owner: int) -> bool:
+        """Record a decline hint (client side): bucket ``x`` is owned
+        by shard ``owner`` as of ``epoch``.  Only hints NEWER than the
+        installed epoch stick, so a Byzantine replica can at worst
+        trigger one wasted re-route, never roll routing back — and an
+        ABSURDLY far-future epoch is rejected outright, or one hostile
+        decline could pin a bucket's hint above every honest epoch the
+        fleet will ever reach (a per-bucket liveness DoS)."""
+        if epoch <= self.route_epoch() or owner is None:
+            return False
+        if epoch > self.route_epoch() + 1_000_000:
+            metrics.incr("quorum.route.hint_absurd")
+            return False
+        b = route_bucket(x)
+        cur = self._hints.get(b)
+        if cur is not None and cur[0] >= epoch:
+            return False
+        self._hints[b] = (epoch, int(owner))
+        metrics.incr("quorum.route.hints")
+        return True
+
+    def dual_pull_shards(self) -> set[int]:
+        """Shard indices this node must ALSO anti-entropy from: the old
+        owners of buckets it newly owns (pre-copy / dual window), plus
+        the new owners of buckets it is handing off (so the old owner
+        converges in-flight tails before going inert)."""
+        topo = self._topology()
+        r = self._routing(topo)
+        if r is None:
+            return set()
+        mine = topo.shard_index_of(self.g.get_self_id())
+        if mine is None:
+            return set()
+        owner, dual, _ = r
+        out: set[int] = set()
+        for b, old in dual.items():
+            if owner[b] == mine and old != mine:
+                out.add(old)
+            elif old == mine and owner[b] != mine:
+                out.add(owner[b])
+        return out
+
+    def signs_for(self, x: bytes) -> bool:
+        """Whether this node holds a sign seat for ``x``: a clique
+        member of the owner shard — or of the dual old-owner shard
+        inside the window (it must keep issuing shares for versions it
+        already stored: certify-on-read, repair, in-flight tails)."""
+        topo = self._topology()
+        if len(topo.shards) <= 1:
+            qa = self.choose_quorum(q.AUTH)
+            myid = self.g.get_self_id()
+            return any(n.id == myid for n in qa.nodes())
+        myid = self.g.get_self_id()
+        mine = topo.member.get(myid)
+        if mine is None:
+            return False  # storage plane never signs
+        b = route_bucket(x)
+        if self._owner_idx(b, topo) == mine:
+            return True
+        r = self._routing(topo)
+        return r is not None and r[1].get(b) == mine
+
+    def alt_quorums_for(self, x: bytes, rw: int) -> list[WotQuorum]:
+        """Extra quorums a verifier may accept for ``x`` during the
+        dual-epoch window: the old owner's, in VERIFY VIEW.  Empty
+        outside a window — after the drain re-certifies migrated
+        records, only the owner quorum vouches (DESIGN.md §15.3).
+
+        Verify view matters: a clique server's trust weight into a
+        FOREIGN clique is zero (cliques cross-sign internally only), so
+        the reference's low-weight-viewer rule would zero ``suff`` and
+        make the old clique's signatures unjudgeable exactly where
+        migration admission needs to judge them."""
+        topo = self._topology()
+        r = self._routing(topo)
+        if r is None:
+            return []
+        old = r[1].get(route_bucket(x))
+        if old is None:
+            return []
+        return [self.quorum_for_shard(old, rw, verify_view=True)]
+
+    def bucket_load(self) -> list[int]:
+        """Per-bucket route-selection counts since the last reset."""
+        return list(self._bucket_load)
+
+    def reset_bucket_load(self) -> None:
+        self._bucket_load = [0] * ROUTE_BUCKETS
+
+    # -- shard introspection ----------------------------------------------
+
     def shard_count(self) -> int:
         return len(self._topology().shards)
 
     def shard_of(self, x: bytes) -> int | None:
-        """The shard index owning variable ``x`` (None = unsharded)."""
-        return self._topology().shard_of_bucket(route_bucket(x))
+        """The shard index owning variable ``x`` (None = unsharded),
+        under the installed route epoch + any newer decline hints."""
+        topo = self._topology()
+        if not topo.table:
+            return None
+        return self._owner_idx(route_bucket(x), topo, with_hints=True)
 
     def shard_index_of(self, node_id: int) -> int | None:
         """Which shard a node serves: its clique's index, or — for a
@@ -379,37 +786,42 @@ class WotQS:
 
     def owns(self, x: bytes) -> bool:
         """Admission gate: does this node's shard own ``x``?  Always
-        True on unsharded graphs and for unassigned principals."""
-        topo = self._topology()
-        if len(topo.shards) <= 1:
-            return True
-        mine = topo.shard_index_of(self.g.get_self_id())
-        if mine is None:
-            return True
-        return topo.shard_of_bucket(route_bucket(x)) == mine
+        True on unsharded graphs and for unassigned principals; inside
+        a dual-epoch window the OLD owner still counts (it serves,
+        syncs, and certifies stored versions until the drain ends)."""
+        return self.route_role(x) != "foreign"
 
     def shard_buckets(self) -> list[int]:
-        """Route buckets assigned to each shard (``[ROUTE_BUCKETS]``
-        when unsharded) — the balance series benches report."""
+        """Route buckets assigned to each shard under the installed
+        epoch (``[ROUTE_BUCKETS]`` when unsharded) — the balance series
+        benches report."""
         topo = self._topology()
         if len(topo.shards) <= 1:
             return [ROUTE_BUCKETS]
         counts = [0] * len(topo.shards)
-        for i in topo.table:
-            counts[i] += 1
+        for b in range(ROUTE_BUCKETS):
+            counts[self._owner_idx(b, topo)] += 1
         return counts
 
     def owned_buckets(self) -> set[int] | None:
-        """The route buckets this node's shard owns, or None when every
-        bucket is local (unsharded graph / unassigned principal) — the
-        anti-entropy plane's pull filter."""
+        """The route buckets this node's shard owns under the installed
+        epoch — plus, inside a dual-epoch window, the moving buckets it
+        is old owner of (it must keep converging them until the drain
+        ends).  None when every bucket is local (unsharded graph /
+        unassigned principal) — the anti-entropy plane's pull filter."""
         topo = self._topology()
         if len(topo.shards) <= 1:
             return None
         mine = topo.shard_index_of(self.g.get_self_id())
         if mine is None:
             return None
-        return {b for b in range(ROUTE_BUCKETS) if topo.table[b] == mine}
+        r = self._routing(topo)
+        out = set()
+        for b in range(ROUTE_BUCKETS):
+            owner = r[0][b] if r is not None else topo.table[b]
+            if owner == mine or (r is not None and r[1].get(b) == mine):
+                out.add(b)
+        return out
 
     def seat_info(self, node_id: int | None = None) -> dict:
         """One node's shard seat + its clique's b-masking thresholds —
@@ -431,6 +843,7 @@ class WotQS:
         topo = self._topology()
         nsh = len(topo.shards)
         mine = topo.shard_index_of(node_id)
+        r = self._routing(topo)
         out: dict = {
             "shard": (
                 mine if nsh > 1 else (0 if mine is not None else None)
@@ -439,6 +852,11 @@ class WotQS:
             "role": None,
             "clique": None,
             "owned_buckets": ROUTE_BUCKETS,
+            # Epoched routing: the installed route-table epoch (0 =
+            # pure HRW) and the dual-window width — the fleet plane's
+            # epoch-skew signal rides on members disagreeing here.
+            "epoch": self.route_epoch(),
+            "dual_buckets": len(r[1]) if r is not None else 0,
         }
         if mine is None:
             return out
@@ -446,7 +864,11 @@ class WotQS:
             "clique" if topo.member.get(node_id) == mine else "storage"
         )
         if nsh > 1:
-            out["owned_buckets"] = sum(1 for b in topo.table if b == mine)
+            out["owned_buckets"] = sum(
+                1
+                for b in range(ROUTE_BUCKETS)
+                if self._owner_idx(b, topo) == mine
+            )
         clique = topo.shards[mine]
         n = len(clique.nodes)
         f, _min, threshold, suff = bmasking_params(n)
@@ -470,6 +892,33 @@ class WotQS:
         shard's record) builds the owner-clique quorum explicitly,
         with READ/WRITE complements drawn from the shard's complement
         partition so no operation ever fans out beyond its shard."""
+        topo = self._topology()
+        if len(topo.shards) <= 1:
+            return self.choose_quorum(rw)
+        b = route_bucket(x)
+        idx = self._owner_idx(b, topo, with_hints=True)
+        self._bucket_load[b] += 1
+        metrics.incr("quorum.route.shard", labels={"shard": idx})
+        return self.quorum_for_shard(idx, rw)
+
+    def quorum_for_shard(
+        self, idx: int, rw: int, verify_view: bool = False
+    ) -> WotQuorum:
+        """The quorum of shard ``idx`` by INDEX — the keyed selection
+        seam :meth:`choose_quorum_for` routes through, public so a
+        decline-hinted client (and the migration executor) can address
+        an owner clique directly.
+
+        ``verify_view``: build the quorum for JUDGING collective
+        signatures rather than collecting them — ``suff`` comes from
+        the clique's own b-masking parameters regardless of this
+        viewer's trust weight into the clique.  The low-weight veto
+        protects a viewer collecting shares it cannot vouch for; a
+        verifier only counts cryptographically checked signatures
+        against the clique the shared certificate graph defines, which
+        is what every clique member does natively.  Migration admission
+        (sync pulls of the old owner's certified records, checker
+        audits across an epoch change) runs in this view."""
         # Read the generation BEFORE fetching the topology: a mutation
         # landing between the two makes gen newer than the topo and the
         # store guard below rejects the result — reading gen after
@@ -479,11 +928,15 @@ class WotQS:
         topo = self._topology()
         if len(topo.shards) <= 1:
             return self.choose_quorum(rw)
-        idx = topo.table[route_bucket(x)]
-        metrics.incr("quorum.route.shard", labels={"shard": idx})
+        if not 0 <= idx < len(topo.shards):
+            # Cross-generation race: the index came from a topology
+            # that no longer exists (a clique dissolved between route
+            # resolution and this call).  The classic path is the safe
+            # degradation — admission on the far side still gates.
+            return self.choose_quorum(rw)
         if topo.member.get(self.g.get_self_id()) == idx:
             return self.choose_quorum(rw)
-        key = (rw, idx)
+        key = (rw, idx, verify_view)
         with self._cache_lock:
             if gen is None or gen != self._kcache_gen:
                 self._kcache.clear()
@@ -494,7 +947,9 @@ class WotQS:
                     metrics.incr("quorum.cache.hits")
                     return quorum
         metrics.incr("quorum.cache.misses")
-        quorum = self._quorum_for_shard(rw, idx, topo)
+        quorum = self._quorum_for_shard(
+            rw, idx, topo, verify_view=verify_view
+        )
         if gen is not None:
             with self._cache_lock:
                 if (
@@ -505,7 +960,8 @@ class WotQS:
         return quorum
 
     def _quorum_for_shard(
-        self, rw: int, idx: int, topo: _ShardTopo
+        self, rw: int, idx: int, topo: _ShardTopo,
+        verify_view: bool = False,
     ) -> WotQuorum:
         """Build the owner clique's quorum from a non-member's seat —
         the same b-masking construction as :meth:`_quorum_from`, with
@@ -516,7 +972,13 @@ class WotQS:
         owner = topo.shards[idx]
         sid = self.g.get_self_id()
         nodes = list(owner.nodes)
-        weight = self.g.weight_from(sid, nodes)
+        # Verify view: judge signatures against the clique's own
+        # b-masking ``suff`` — the viewer-weight veto would zero it
+        # for any server outside the clique (see quorum_for_shard).
+        weight = (
+            len(nodes) if verify_view
+            else self.g.weight_from(sid, nodes)
+        )
         qcs: list[QC] = []
         qc = self._new_qc(nodes, weight, rw | q.AUTH)
         if qc is not None:

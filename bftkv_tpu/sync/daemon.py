@@ -142,6 +142,30 @@ def admit_records(server, records: list[bytes]) -> dict:
             verrs = server.crypt.collective.verify_many(
                 jobs, qa, server.crypt.keyring
             )
+        # Dual-epoch migration window (DESIGN.md §15): records the OLD
+        # owner clique certified while it owned the bucket must be
+        # admissible at the NEW owner — that pull IS the pre-copy.
+        # Failures retry per-record against the dual quorum the route
+        # table names for that record's bucket; outside a window
+        # alt_quorums_for is empty and nothing changes.
+        if any(e is not None for e in verrs):
+            alt_of = getattr(server.qs, "alt_quorums_for", None)
+            if alt_of is not None:
+                live = [e for e in parsed if e is not None]
+                for j, err in enumerate(verrs):
+                    if err is None:
+                        continue
+                    raw_j, p_j, tbss_j = live[j]
+                    for alt in alt_of(p_j.variable or b"", qm.AUTH):
+                        try:
+                            server.crypt.collective.verify(
+                                tbss_j, p_j.ss, alt, server.crypt.keyring
+                            )
+                            verrs[j] = None
+                            metrics.incr("sync.pull.dual_verified")
+                            break
+                        except Exception:
+                            continue
     else:
         verrs = []
 
@@ -273,8 +297,14 @@ class SyncDaemon:
         mine = idx_of(self.server.self_node.get_self_id())
         if mine is None:
             return peers
+        # Epoched migration (DESIGN.md §15): during a pre-copy / dual
+        # window the new owner of a moving bucket must pull from the
+        # OLD owner's shard (and the old owner from the new, so its
+        # in-flight tails converge before it goes inert) — the dual
+        # shard set widens the poll set for exactly that window.
+        keep = {mine} | getattr(qs, "dual_pull_shards", lambda: set())()
         return [
-            n for n in peers if idx_of(n.id) is None or idx_of(n.id) == mine
+            n for n in peers if idx_of(n.id) is None or idx_of(n.id) in keep
         ]
 
     def _ask(self, cmd: int, peer, payload: bytes) -> bytes | None:
@@ -514,6 +544,21 @@ class SyncDaemon:
         # client-shaped AUTH|PEER view is empty on a server — same
         # quorum flags admit_records verifies with).
         qa = qm.choose_quorum_for(srv.qs, variable, qm.AUTH)
+        # Residue whose bucket migrated AWAY (epoch flip): the owner is
+        # now a foreign clique, and this seat's trust weight into it is
+        # zero — the low-weight veto would zero ``suff`` and the round
+        # could never combine.  Judge sufficiency in verify view: the
+        # shares are still cryptographically checked against the owner
+        # clique the shared certificate graph defines (DESIGN.md §15).
+        qs = srv.qs
+        shard_of = getattr(qs, "shard_of", None)
+        my_shard = getattr(qs, "my_shard", None)
+        qfs = getattr(qs, "quorum_for_shard", None)
+        if shard_of is not None and my_shard is not None and qfs is not None:
+            owner = shard_of(variable)
+            mine = my_shard()
+            if owner is not None and mine is not None and owner != mine:
+                qa = qfs(owner, qm.AUTH, True)
         req = pkt.serialize(variable, p.value, t, p.sig, None)
         tbss = pkt.tbss(raw)
         ss = None
@@ -569,6 +614,61 @@ class SyncDaemon:
         except Exception:
             log.exception("repair: local admission of %r failed", variable)
         return "certified", rec
+
+    def recertify_buckets(self, buckets: set[int] | None = None) -> dict:
+        """Migration drain sweep (DESIGN.md §15.3): re-certify every
+        completed record in ``buckets`` (default: all owned) whose
+        collective signature does NOT verify against this replica's
+        owner quorum — i.e. records pre-copied from the clique that
+        owned the bucket in an earlier epoch.  The same idempotent SIGN
+        round the repair plane uses mints a fresh owner-clique
+        signature over the EXACT stored ``<x, v, t, sig>`` (the one
+        re-sign the equivocation rule permits), so after the sweep the
+        bucket's history verifies against its new owner alone and the
+        dual-epoch verification window can close."""
+        from bftkv_tpu.quorum.wotqs import route_bucket
+
+        srv = self.server
+        stats = {"scanned": 0, "recertified": 0, "failed": 0}
+        owned = None
+        get_owned = getattr(srv.qs, "owned_buckets", None)
+        if get_owned is not None:
+            owned = get_owned()
+        certified: list[tuple[bytes, bytes]] = []
+        for variable in sorted(srv.storage.keys()):
+            if variable.startswith(HIDDEN_PREFIX):
+                continue
+            b = route_bucket(variable)
+            if buckets is not None and b not in buckets:
+                continue
+            if owned is not None and b not in owned:
+                continue
+            rec = latest_completed(srv.storage, variable)
+            if rec is None:
+                continue
+            t, raw, p = rec
+            if p.auth is not None:
+                continue  # TPA-protected: needs the client's proof
+            stats["scanned"] += 1
+            qa = qm.choose_quorum_for(srv.qs, variable, qm.AUTH)
+            try:
+                srv.crypt.collective.verify(
+                    pkt.tbss(raw), p.ss, qa, srv.crypt.keyring
+                )
+                continue  # already vouched for by the owner quorum
+            except Exception:
+                pass
+            verdict, out = self._certify_record(variable, t, raw, p)
+            if verdict == "certified":
+                stats["recertified"] += 1
+                metrics.incr("sync.recertified")
+                certified.append((variable, out))
+            else:
+                stats["failed"] += 1
+                metrics.incr("sync.recertify_failed")
+        if certified:
+            self._backfill(certified)
+        return stats
 
     def _backfill(self, items: list[tuple[bytes, bytes]]) -> None:
         """Push certified records plane-wide through the same back-fill

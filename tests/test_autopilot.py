@@ -1,0 +1,392 @@
+"""Topology autopilot (DESIGN.md §15): pure decisions, the 3-phase
+split executor under live writes (the CI "Split smoke"), clique
+retirement with the recorded-history handoff check, and spare
+admission through the graph-generation guards."""
+
+import threading
+
+import pytest
+
+from bftkv_tpu import quorum as q
+from bftkv_tpu.autopilot import Autopilot, Plan, decide
+from bftkv_tpu.autopilot.plan import next_table
+from bftkv_tpu.quorum.wotqs import ROUTE_BUCKETS, route_bucket
+
+
+# -- decisions (pure) -----------------------------------------------------
+
+
+def test_decide_nothing_on_balance():
+    owner = [b % 2 for b in range(ROUTE_BUCKETS)]
+    load = [1] * ROUTE_BUCKETS
+    assert decide({0: 1, 1: 1}, load, owner, 2) is None
+
+
+def test_decide_split_hot_shard():
+    owner = [b % 2 for b in range(ROUTE_BUCKETS)]
+    load = [0] * ROUTE_BUCKETS
+    for b in range(0, 40, 2):  # hot buckets all on shard 0
+        load[b] = 50
+    plan = decide({0: 1, 1: 1}, load, owner, 2)
+    assert plan is not None and plan.kind == "split" and plan.shard == 0
+    assert plan.assign and set(plan.assign.values()) == {1}
+    # only observed-hot buckets move, roughly half the hot load
+    assert all(load[b] > 0 for b in plan.assign)
+
+
+def test_decide_retire_beats_split():
+    owner = [b % 2 for b in range(ROUTE_BUCKETS)]
+    load = [10] * ROUTE_BUCKETS
+    plan = decide({0: 1, 1: -1}, load, owner, 2)
+    assert plan is not None and plan.kind == "retire" and plan.shard == 1
+    assert set(plan.assign) == {
+        b for b in range(ROUTE_BUCKETS) if owner[b] == 1
+    }
+    assert set(plan.assign.values()) == {0}
+    # retire needs a healthy destination
+    assert decide({0: -1, 1: -1}, load, owner, 2) is None
+    # and at least two shards
+    assert decide({0: -1}, load, [0] * ROUTE_BUCKETS, 1) is None
+
+
+def test_decide_ignores_tiny_load():
+    owner = [b % 2 for b in range(ROUTE_BUCKETS)]
+    load = [0] * ROUTE_BUCKETS
+    load[0] = 5
+    assert decide({0: 1, 1: 1}, load, owner, 2) is None
+
+
+def test_autopilot_hatch(monkeypatch):
+    from bftkv_tpu.autopilot import autopilot_enabled
+
+    assert autopilot_enabled()
+    monkeypatch.setenv("BFTKV_AUTOPILOT", "off")
+    assert not autopilot_enabled()
+
+
+# -- live clusters --------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def split_cluster():
+    from tests.cluster_utils import start_cluster
+
+    cluster = start_cluster(4, 2, 4, bits=1024, n_shards=2)
+    yield cluster
+    cluster.stop()
+
+
+def hot_keys_for(qs, shard, n, tag=b"hot"):
+    out, i = [], 0
+    while len(out) < n and i < 65536:
+        k = b"%s/%d" % (tag, i)
+        i += 1
+        if qs.shard_of(k) == shard:
+            out.append(k)
+    return out
+
+
+def test_split_smoke(split_cluster):
+    """The CI tier-1 "Split smoke": a hot-shard workload on a 2-clique
+    loopback fleet triggers an automatic split; writes keep succeeding
+    ACROSS the flip; the moved keys' history and new writes are
+    readable afterwards; every member lands on the finalize epoch."""
+    cluster = split_cluster
+    cl = cluster.clients[0]
+    qs = cl.qs
+    keys = hot_keys_for(qs, 0, 16)
+    for k in keys:
+        cl.write(k, b"v1-" + k)
+    cl.drain_tails()
+
+    ap = Autopilot.for_cluster(cluster)
+    plan = ap.decide()
+    assert plan is not None and plan.kind == "split" and plan.shard == 0
+
+    stop = threading.Event()
+    failures: list = []
+    writes_ok = [0]
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            i += 1
+            k = keys[i % len(keys)]
+            try:
+                cl.write(k, b"w%d-" % i + k)
+                writes_ok[0] += 1
+            except Exception as e:
+                failures.append(e)
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    try:
+        report = ap.execute(plan, pace=0.1)
+    finally:
+        stop.set()
+        t.join(10)
+    cl.drain_tails()
+
+    assert report["ok"], report
+    assert not failures, failures[:3]
+    assert writes_ok[0] > 0  # availability never dropped to zero
+    moved = [k for k in keys if qs.shard_of(k) == 1]
+    assert moved, "no hot key rerouted by the split"
+    # every member + client on the finalize epoch
+    epochs = {s.qs.route_epoch() for s in cluster.all_servers}
+    epochs |= {c.qs.route_epoch() for c in cluster.clients}
+    assert epochs == {report["final_epoch"]}
+    # history and fresh writes readable after the flip
+    for k in keys:
+        assert cl.read(k) is not None
+    for k in moved[:4]:
+        cl.write(k, b"post-" + k)
+    cl.drain_tails()
+    for k in moved[:4]:
+        assert cl.read(k) == b"post-" + k
+    # migrated history re-certified against the NEW owner clique: a
+    # new-owner replica verifies its stored record with its own quorum
+    from bftkv_tpu import packet as pkt
+    from bftkv_tpu.sync.digest import latest_completed
+
+    new_members = [
+        s
+        for s in cluster.all_servers
+        if s.qs.shard_index_of(s.self_node.get_self_id()) == 1
+    ]
+    checked = 0
+    for srv in new_members:
+        for k in moved:
+            rec = latest_completed(srv.storage, k)
+            if rec is None:
+                continue
+            _t, raw, p = rec
+            srv.crypt.collective.verify(
+                pkt.tbss(raw),
+                p.ss,
+                q.choose_quorum_for(srv.qs, k, q.AUTH),
+                srv.crypt.keyring,
+            )
+            checked += 1
+    assert checked > 0
+
+
+def test_status_and_last_decision(split_cluster):
+    ap = Autopilot.for_cluster(split_cluster)
+    st = ap.status()
+    assert "enabled" in st and "epoch" in st and "last" in st
+
+
+def test_retire_spent_clique():
+    """Retiring a clique whose f-budget is exhausted: every bucket's
+    certified records must be readable from the new owner BEFORE the
+    old clique stops being routed to (the recorded-history check), and
+    new writes re-route off the hinted declines."""
+    from bftkv_tpu.faults.harness import build_cluster
+
+    cluster = build_cluster(4, 1, 4, bits=1024, n_shards=2)
+    try:
+        cl = cluster.clients[0]
+        qs = cl.qs
+        keys = hot_keys_for(qs, 1, 10, tag=b"ret")
+        for k in keys:
+            cl.write(k, b"v-" + k)
+        cl.drain_tails()
+
+        ap = Autopilot.for_cluster(cluster)
+        owner = qs.effective_route()
+        assign = {
+            b: 0 for b in range(ROUTE_BUCKETS) if owner[b] == 1
+        }
+        report = ap.execute(Plan("retire", 1, assign, reason="test"))
+        assert report["ok"], report
+        # the recorded-history check ran clean pre-flip
+        assert "handoff_misses" not in report
+        assert ap.verify_handoff(
+            set(assign),
+            [
+                s
+                for s in cluster.all_servers
+                if s.qs.shard_index_of(s.self_node.get_self_id()) == 1
+            ],
+            [
+                s
+                for s in cluster.all_servers
+                if s.qs.shard_index_of(s.self_node.get_self_id()) == 0
+            ],
+        ) == []
+        # every certified record readable via the surviving clique
+        for k in keys:
+            assert cl.read(k) == b"v-" + k
+            assert qs.shard_of(k) == 0
+        for k in keys[:4]:
+            cl.write(k, b"v2-" + k)
+        cl.drain_tails()
+        for k in keys[:4]:
+            assert cl.read(k) == b"v2-" + k
+        assert 1 in ap.status()["retired"]
+    finally:
+        cluster.stop()
+
+
+def test_decide_retire_from_real_f_budget():
+    """The full detect→decide loop for retirement: crash enough of one
+    clique that the fleet collector's f-budget hits zero, and the
+    autopilot's next decision is to retire that clique."""
+    from bftkv_tpu import trace as trmod
+    from bftkv_tpu.faults.harness import build_cluster
+    from bftkv_tpu.metrics import registry as mreg
+    from bftkv_tpu.obs import FleetCollector, LocalSource
+
+    cluster = build_cluster(4, 1, 4, bits=1024, n_shards=2)
+    try:
+        cl = cluster.clients[0]
+        keys = hot_keys_for(cl.qs, 1, 6, tag=b"fb")
+        for k in keys:
+            cl.write(k, b"v-" + k)
+        cl.drain_tails()
+        collector = FleetCollector(
+            [
+                LocalSource(
+                    name,
+                    lambda n=name: cluster.server_named(n),
+                )
+                for name in sorted(cluster._by_name)
+            ],
+            local_metrics=mreg,
+            local_tracer=trmod.tracer,
+        )
+        collector.scrape_once()
+        ap = Autopilot.for_cluster(cluster, collector=collector)
+        # healthy fleet: no retirement decision
+        plan = ap.decide()
+        assert plan is None or plan.kind != "retire"
+        # shard 1's clique loses f+1 members: budget exhausted
+        byid = {
+            s.qs.shard_index_of(s.self_node.get_self_id()): []
+            for s in cluster.servers
+        }
+        for s in cluster.servers:
+            byid[
+                s.qs.shard_index_of(s.self_node.get_self_id())
+            ].append(s.self_node.name)
+        for name in byid[1][:2]:  # f=1 for a 4-clique: 2 down = spent
+            cluster.crash(name)
+        collector.scrape_once()
+        doc = collector.health()
+        assert doc["shards"]["1"]["f_budget"]["remaining"] <= 0
+        plan = ap.decide()
+        assert plan is not None and plan.kind == "retire"
+        assert plan.shard == 1
+        # the plan drains every bucket the spent clique owns, to the
+        # surviving shard
+        assert set(plan.assign.values()) == {0}
+        # executing it under the crash still completes: the surviving
+        # clique members + storage plane hold the certified history
+        report = ap.execute(plan)
+        assert report["ok"], report
+        for k in keys:
+            assert cl.read(k) == b"v-" + k
+            assert cl.qs.shard_of(k) == 0
+    finally:
+        cluster.stop()
+
+
+def test_retire_blocked_without_copy():
+    """A retirement whose pre-copy cannot complete must NOT flip: the
+    old clique keeps being routed to (abort + rescind), rather than
+    stranding certified history."""
+    from bftkv_tpu.faults.harness import build_cluster
+
+    cluster = build_cluster(4, 1, 4, bits=1024, n_shards=2)
+    try:
+        cl = cluster.clients[0]
+        qs = cl.qs
+        keys = hot_keys_for(qs, 1, 4, tag=b"blocked")
+        for k in keys:
+            cl.write(k, b"v-" + k)
+        cl.drain_tails()
+        ap = Autopilot.for_cluster(cluster)
+        ap.MAX_SYNC_ROUNDS = 0  # pre-copy can make no progress
+        owner = qs.effective_route()
+        assign = {
+            b: 0 for b in range(ROUTE_BUCKETS) if owner[b] == 1
+        }
+        report = ap.execute(Plan("retire", 1, assign, reason="test"))
+        assert not report["ok"]
+        assert report["aborted"] == "precopy_blocked"
+        # routing unchanged: the old clique still serves its keys
+        for k in keys:
+            assert qs.shard_of(k) == 1
+            assert cl.read(k) == b"v-" + k
+    finally:
+        cluster.stop()
+
+
+def test_admit_spares_bumps_generation():
+    from bftkv_tpu import topology
+    from tests.cluster_utils import start_cluster
+
+    cluster = start_cluster(4, 1, 2, bits=1024, n_shards=1)
+    try:
+        ap = Autopilot.for_cluster(cluster)
+        spare = topology.new_identity(
+            "sp01", address="loop://sp01", uid="sp01@spare", bits=1024
+        )
+        gens = {
+            id(s): s.self_node.generation for s in cluster.all_servers
+        }
+        accepted = ap.admit_spares([spare.cert])
+        assert accepted == len(cluster.all_servers) + len(cluster.clients)
+        for s in cluster.all_servers:
+            assert s.self_node.generation > gens[id(s)]
+            assert s.crypt.keyring.get(spare.cert.id) is not None
+    finally:
+        cluster.stop()
+
+
+def test_issue_table_linearizes():
+    """Tables issued concurrently (a flap racing a migration) must get
+    distinct epochs and CHAIN contents — later tables keep earlier
+    moves."""
+    from tests.cluster_utils import start_cluster
+
+    cluster = start_cluster(4, 1, 4, bits=1024, n_shards=2)
+    try:
+        ap = Autopilot.for_cluster(cluster)
+        qs = cluster.clients[0].qs
+        owner = qs.effective_route()
+        b1 = next(b for b in range(ROUTE_BUCKETS) if owner[b] == 0)
+        b2 = next(
+            b for b in range(ROUTE_BUCKETS) if owner[b] == 0 and b != b1
+        )
+        rt1 = ap.issue_table({b1: 1}, dual=False)
+        rt2 = ap.issue_table({b2: 1}, dual=False)
+        assert rt2.epoch > rt1.epoch
+        # rt2 keeps rt1's move
+        assert rt2.cliques[rt2.table[b1]] == rt1.cliques[rt1.table[b1]]
+        # a STAGED table stays out of the chain
+        rt_stage = ap.issue_table({b1: 0}, dual=True, stage=True)
+        rt3 = ap.issue_table({}, dual=False)
+        assert rt3.epoch > rt_stage.epoch
+        assert rt3.cliques[rt3.table[b1]] == rt2.cliques[rt2.table[b1]]
+    finally:
+        cluster.stop()
+
+
+def test_next_table_shapes():
+    from tests.cluster_utils import start_cluster
+
+    cluster = start_cluster(4, 1, 4, bits=1024, n_shards=2)
+    try:
+        qs = cluster.clients[0].qs
+        owner = qs.effective_route()
+        b = next(i for i in range(ROUTE_BUCKETS) if owner[i] == 0)
+        rt = next_table(qs, {b: 1}, dual=True)
+        assert rt.epoch == 1
+        assert rt.dual == {b: 0}
+        rt2 = next_table(qs, {b: 1}, dual=False, retiring={0})
+        assert rt2.dual == {}
+        assert rt2.retiring == {0}
+    finally:
+        cluster.stop()

@@ -1,0 +1,293 @@
+"""Epoched route table (DESIGN.md §15): RouteTable wire format +
+signing, WotQS install/ownership/dual-window semantics at the graph
+level, and the end-to-end stale-route decline → in-round reroute loop
+on a 2-shard loopback cluster."""
+
+import pytest
+
+from bftkv_tpu import quorum as q
+from bftkv_tpu.errors import (
+    ERR_WRONG_SHARD,
+    parse_wrong_shard,
+    wrong_shard_error,
+)
+from bftkv_tpu.quorum.wotqs import (
+    ROUTE_BUCKETS,
+    RouteTable,
+    WotQS,
+    route_bucket,
+)
+from tests.test_shard_quorum import build, mk_shard_universe
+
+
+def mk_qs(universe, who="u01"):
+    return WotQS(build(universe, who))
+
+
+def flip_table(qs, moves: dict, *, dual=True, epoch=None, retiring=()):
+    owner = qs.effective_route()
+    table = list(owner)
+    dual_map = {}
+    for b, dest in moves.items():
+        if dual:
+            dual_map[b] = table[b]
+        table[b] = dest
+    return RouteTable(
+        epoch=(qs.route_epoch() + 1) if epoch is None else epoch,
+        cliques=qs.route_cliques(),
+        table=table,
+        dual=dual_map,
+        retiring=retiring,
+    )
+
+
+# -- wire format ----------------------------------------------------------
+
+
+def test_route_table_roundtrip(universe):
+    qs = mk_qs(universe)
+    rt = flip_table(qs, {3: 1, 7: 0}, retiring={1})
+    rt2 = RouteTable.parse(rt.serialize())
+    assert rt2.epoch == rt.epoch
+    assert rt2.cliques == rt.cliques
+    assert rt2.table == rt.table
+    assert rt2.dual == rt.dual
+    assert rt2.retiring == rt.retiring
+
+
+def test_route_table_sign_verify():
+    from bftkv_tpu import topology
+    from bftkv_tpu.crypto.keyring import Keyring
+
+    ident = topology.new_identity("ap01", bits=1024)
+    ring = Keyring()
+    ring.register([ident.cert])
+    rt = RouteTable(
+        epoch=2,
+        cliques=(1, 2),
+        table=[0] * ROUTE_BUCKETS,
+        dual={5: 1},
+    )
+    rt.sign(ident.key, ident.cert)
+    assert rt.verify(ring)
+    rt2 = RouteTable.parse(rt.serialize())
+    assert rt2.verify(ring)
+    rt2.epoch = 3  # tamper
+    assert not rt2.verify(ring)
+    # unknown issuer
+    assert not RouteTable.parse(rt.serialize()).verify(Keyring())
+
+
+@pytest.fixture()
+def universe():
+    return mk_shard_universe()
+
+
+# -- install semantics ----------------------------------------------------
+
+
+def test_install_monotonic(universe):
+    qs = mk_qs(universe)
+    assert qs.route_epoch() == 0
+    rt1 = flip_table(qs, {})
+    assert qs.install_route_table(rt1)
+    assert qs.route_epoch() == 1
+    # re-install of the current epoch is an idempotent True
+    assert qs.install_route_table(rt1)
+    # a stale epoch can never roll routing back
+    stale = flip_table(qs, {}, epoch=0)
+    assert not qs.install_route_table(stale)
+    assert qs.route_epoch() == 1
+    rt2 = flip_table(qs, {})
+    assert qs.install_route_table(rt2)
+    assert qs.route_epoch() == 2
+
+
+def test_signed_install_requires_valid_signature(universe):
+    from bftkv_tpu import topology
+    from bftkv_tpu.crypto.keyring import Keyring
+
+    ident = topology.new_identity("ap01", bits=1024)
+    ring = Keyring()
+    ring.register([ident.cert])
+    qs = mk_qs(universe)
+    rt = flip_table(qs, {})
+    assert not qs.install_route_table(rt, ring)  # unsigned
+    rt.sign(ident.key, ident.cert)
+    assert qs.install_route_table(rt, ring)
+
+
+# -- ownership + dual window ----------------------------------------------
+
+
+def moving_bucket(qs, owner_idx):
+    for b in range(ROUTE_BUCKETS):
+        if qs.effective_route()[b] == owner_idx:
+            return b
+    raise AssertionError("no bucket owned by shard")
+
+
+def var_in_bucket(b):
+    i = 0
+    while True:
+        x = b"ep/%d" % i
+        if route_bucket(x) == b:
+            return x
+        i += 1
+
+
+def test_dual_window_roles(universe):
+    qs_a = mk_qs(universe, "a01")
+    qs_b = mk_qs(universe, "b01")
+    a_idx, b_idx = qs_a.my_shard(), qs_b.my_shard()
+    mb = moving_bucket(qs_a, a_idx)
+    x = var_in_bucket(mb)
+    assert qs_a.route_role(x) == "owner"
+    assert qs_b.route_role(x) == "foreign"
+    # flip mb from a's shard to b's with the dual window open
+    for qs in (qs_a, qs_b):
+        assert qs.install_route_table(
+            flip_table(qs, {mb: b_idx}, dual=True)
+        )
+    assert qs_a.route_role(x) == "dual"
+    assert qs_b.route_role(x) == "owner"
+    assert qs_a.owns(x) and qs_b.owns(x)  # both inside the window
+    assert qs_a.signs_for(x) and qs_b.signs_for(x)
+    assert mb in qs_a.owned_buckets() and mb in qs_b.owned_buckets()
+    assert qs_b.dual_pull_shards() == {a_idx}
+    assert qs_a.dual_pull_shards() == {b_idx}
+    assert len(qs_b.alt_quorums_for(x, q.AUTH)) == 1
+    # finalize: window closes, old owner goes inert
+    for qs in (qs_a, qs_b):
+        assert qs.install_route_table(
+            flip_table(qs, {mb: b_idx}, dual=False)
+        )
+    assert qs_a.route_role(x) == "foreign"
+    assert not qs_a.owns(x) and qs_b.owns(x)
+    assert not qs_a.signs_for(x)
+    assert qs_a.alt_quorums_for(x, q.AUTH) == []
+    assert mb not in qs_a.owned_buckets()
+
+
+def test_stale_routed_and_hint(universe):
+    qs_a = mk_qs(universe, "a01")
+    a_idx = qs_a.my_shard()
+    b_idx = 1 - a_idx
+    mb = moving_bucket(qs_a, a_idx)
+    x = var_in_bucket(mb)
+    assert not qs_a.stale_routed(x)
+    assert qs_a.install_route_table(
+        flip_table(qs_a, {mb: b_idx}, dual=False)
+    )
+    # an epoch-0 client would still send x here: that is a stale route
+    assert qs_a.stale_routed(x)
+    epoch, owner = qs_a.route_hint(x)
+    assert epoch == 1 and owner == b_idx
+
+
+def test_note_route_hint_only_newer(universe):
+    qs = mk_qs(universe)
+    x = b"hint/x"
+    b = route_bucket(x)
+    owner = qs.effective_route()[b]
+    other = 1 - owner
+    assert not qs.note_route_hint(x, 0, other)  # not newer than epoch 0
+    assert qs.note_route_hint(x, 3, other)
+    assert qs.shard_of(x) == other  # hint steers ROUTING...
+    assert qs.effective_route()[b] == owner  # ...but not admission
+    # a newer installed table supersedes the hint
+    assert qs.install_route_table(flip_table(qs, {}, epoch=3))
+    assert qs.shard_of(x) == owner
+
+
+def test_verify_view_quorum_suff(universe):
+    """A clique server's weight into a FOREIGN clique is zero, so the
+    low-weight rule zeroes suff — unless the verify view is requested
+    (migration admission judges the old owner's signatures there)."""
+    qs_a = mk_qs(universe, "a01")
+    b_idx = 1 - qs_a.my_shard()
+    collect = qs_a.quorum_for_shard(b_idx, q.AUTH)
+    judge = qs_a.quorum_for_shard(b_idx, q.AUTH, verify_view=True)
+    assert all(s == 0 for s in collect.bounds()["suff"])
+    assert any(s > 0 for s in judge.bounds()["suff"])
+
+
+def test_seat_info_reports_epoch(universe):
+    qs = mk_qs(universe, "a01")
+    assert qs.seat_info()["epoch"] == 0
+    mb = moving_bucket(qs, qs.my_shard())
+    assert qs.install_route_table(
+        flip_table(qs, {mb: 1 - qs.my_shard()}, dual=True)
+    )
+    info = qs.seat_info()
+    assert info["epoch"] == 1
+    assert info["dual_buckets"] == 1
+
+
+# -- wrong-shard decline format -------------------------------------------
+
+
+def test_wrong_shard_error_forms():
+    bare = wrong_shard_error()
+    assert bare is ERR_WRONG_SHARD
+    assert parse_wrong_shard(bare) == (None, None)
+    hinted = wrong_shard_error(4, 2)
+    assert parse_wrong_shard(hinted) == (4, 2)
+    assert parse_wrong_shard(hinted()) == (4, 2)  # instance too
+    assert parse_wrong_shard("wrong shard epoch=9 owner=0") == (9, 0)
+    assert parse_wrong_shard("bad timestamp") is None
+    # interned round trip through the wire form
+    from bftkv_tpu.errors import error_from_string
+
+    assert parse_wrong_shard(error_from_string(hinted.message)) == (4, 2)
+
+
+# -- end to end: decline → reroute on a loopback cluster -------------------
+
+
+def test_stale_client_reroutes_in_round():
+    from bftkv_tpu.metrics import registry as metrics
+    from tests.cluster_utils import start_cluster
+
+    cluster = start_cluster(4, 2, 4, bits=1024, n_shards=2)
+    try:
+        fresh, stale = cluster.clients
+        qs = fresh.qs
+        x = None
+        i = 0
+        while x is None:
+            c = b"flap/%d" % i
+            i += 1
+            if qs.shard_of(c) == 0:
+                x = c
+        stale.write(x, b"v0")
+        stale.drain_tails()
+        # abrupt flip to shard 1 delivered to everyone EXCEPT `stale`
+        rt = None
+        for principal in cluster.all_servers + [fresh]:
+            pq = principal.qs
+            if rt is None:
+                owner = pq.effective_route()
+                table = list(owner)
+                table[route_bucket(x)] = 1
+                rt = RouteTable(
+                    1, pq.route_cliques(), table, {}, set()
+                )
+            assert pq.install_route_table(rt)
+        metrics.reset()
+        stale.write(x, b"v1")  # declines at the old owner, re-routes
+        stale.drain_tails()
+        snap = metrics.snapshot()
+        assert (
+            sum(
+                v
+                for k, v in snap.items()
+                if k.startswith("server.epoch_stale")
+            )
+            > 0
+        )
+        assert snap.get("client.route.rerouted", 0) > 0
+        assert stale.qs.shard_of(x) == 1  # hint adopted
+        assert fresh.read(x) == b"v1"
+    finally:
+        cluster.stop()

@@ -296,6 +296,96 @@ def test_choose_quorum_generation_race():
     }
 
 
+def test_live_generation_churn_under_writers(universe):
+    """The autopilot's steady state: graph generations keep bumping
+    (spare admission, revocations) WHILE writer threads select keyed
+    quorums.  No quorum may ever be served under the wrong generation:
+    whenever a writer observes a quiescent generation around its call
+    (same before and after), the returned quorum must reflect exactly
+    that generation's membership — here, whether rw08 exists."""
+    g = build(universe, "u01")
+    qs = WotQS(g)
+    rw08 = universe["rw08"]
+    # The route table derives from the CLIQUES alone, so it is stable
+    # under rw (complement) churn; rw08's seat is its round-robin slot,
+    # also stable whenever it is present.  Keys routed to that shard
+    # must include rw08 in their WRITE complement exactly when the
+    # generation they were served under had rw08 in the graph.
+    rw_idx = qs.shard_index_of(rw08.id)
+    assert rw_idx is not None
+    keys = []
+    i = 0
+    while len(keys) < 8:
+        x = b"churn/%d" % i
+        i += 1
+        if qs.shard_of(x) == rw_idx:
+            keys.append(x)
+    stop = threading.Event()
+    present = {}  # generation -> rw08 in the graph at that generation
+    lock = threading.Lock()
+    violations: list = []
+
+    def record(gen: int, has: bool) -> None:
+        with lock:
+            present[gen] = has
+
+    record(g.generation, True)
+
+    def churn():
+        for _ in range(60):
+            g.remove_nodes([rw08])
+            record(g.generation, False)
+            g.add_peers([rw08])
+            record(g.generation, True)
+        stop.set()
+
+    def writer(wi: int):
+        i = 0
+        while not stop.is_set():
+            i += 1
+            x = keys[(wi + i) % len(keys)]
+            gen_before = g.generation
+            quorum = qs.choose_quorum_for(x, q.WRITE)
+            topo_n = qs.shard_count()
+            gen_after = g.generation
+            if gen_before != gen_after:
+                continue  # mutation mid-call: nothing to assert
+            with lock:
+                expect = present.get(gen_before)
+            if expect is None:
+                continue
+            got = any(
+                n.id == rw08.id
+                for qc in quorum.qcs
+                for n in qc.nodes
+            )
+            if got != expect:
+                violations.append(
+                    (wi, gen_before, expect, got)
+                )
+            if topo_n != 2:
+                violations.append((wi, gen_before, "shards", topo_n))
+
+    threads = [
+        threading.Thread(target=writer, args=(wi,), daemon=True)
+        for wi in range(4)
+    ]
+    churner = threading.Thread(target=churn, daemon=True)
+    for t in threads:
+        t.start()
+    churner.start()
+    churner.join(30)
+    stop.set()
+    for t in threads:
+        t.join(10)
+    assert not violations, violations[:5]
+    # and the memos settled on the FINAL generation's world
+    final = qs.choose_quorum_for(keys[0], q.WRITE)
+    assert any(
+        n.id == rw08.id for qc in final.qcs for n in qc.nodes
+    )
+
+
 def test_keyed_topology_generation_race(universe):
     """Same guard discipline for the shard topology memo: a routing
     table computed from the pre-mutation graph must not survive the

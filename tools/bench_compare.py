@@ -46,12 +46,12 @@ __all__ = [
     "REPORT_ONLY",
 ]
 
-#: Sections printed but never gated.  Empty since BENCH_r07 landed
-#: cluster_4_gray: the gray section now gates like any other —
-#: throughput/p50 between rounds PLUS the absolute gray-slowdown bound
-#: below (it rode REPORT_ONLY only for its first landing, when there
-#: was no prior round to diff against).
-REPORT_ONLY: set = set()
+#: Sections printed but never gated.  cluster_split rides REPORT_ONLY
+#: for its first landing (the cluster_4_gray precedent: no prior round
+#: to diff against, and its headline is a post-migration rate whose
+#: pre/post ratio is the real deliverable) — promote it to gated in a
+#: later round once a committed BENCH_r* carries it.
+REPORT_ONLY: set = {"cluster_split"}
 
 #: Absolute bound on the NEW record's hedged gray slowdown (write p50
 #: with one delayed clique member ÷ fault-free floor) — the DESIGN.md
