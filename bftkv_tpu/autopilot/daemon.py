@@ -35,7 +35,6 @@ the executor stays callable for operator-forced migrations.
 from __future__ import annotations
 
 import logging
-import os
 import random
 import threading
 import time
@@ -43,6 +42,8 @@ import time
 from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.quorum.wotqs import ROUTE_BUCKETS, RouteTable, route_bucket
 from bftkv_tpu.autopilot.plan import HOT_SKEW, MIN_LOAD, Plan, decide
+from bftkv_tpu import flags
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = ["Autopilot", "autopilot_enabled"]
 
@@ -52,7 +53,7 @@ log = logging.getLogger("bftkv_tpu.autopilot")
 def autopilot_enabled() -> bool:
     """``BFTKV_AUTOPILOT`` — automatic topology decisions (default
     on).  Off disables DECIDING only; forced executes stay available."""
-    return os.environ.get("BFTKV_AUTOPILOT", "on").lower() not in (
+    return flags.raw("BFTKV_AUTOPILOT", "on").lower() not in (
         "off", "0", "false",
     )
 
@@ -106,7 +107,7 @@ class Autopilot:
         self.last_decision: dict = {"kind": None}
         self.history: list[dict] = []
         self._retired: set[int] = set()
-        self._lock = threading.Lock()
+        self._lock = named_lock("autopilot")
         self._epoch_hwm = 0  # see alloc_epoch
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()

@@ -35,6 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from bftkv_tpu.errors import ERR_NOT_FOUND, Error
 from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu import flags
 
 MAX_UINT64 = (1 << 64) - 1
 
@@ -53,7 +54,7 @@ def build_server(args):
         # write); BFTKV_PLAIN_FSYNC=0 opts a deployment out.
         storage = PlainStorage(
             args.db,
-            fsync=os.environ.get("BFTKV_PLAIN_FSYNC", "1") != "0",
+            fsync=flags.raw("BFTKV_PLAIN_FSYNC", "1") != "0",
         )
     elif args.storage == "native":
         from bftkv_tpu.storage.native import NativeStorage
@@ -336,7 +337,7 @@ class _ApiService:
                     mine = qs.my_shard()
                     lines.append(
                         f"shards: {nsh} (mine={mine}, "
-                        f"owned_buckets="
+                        "owned_buckets="
                         f"{'all' if owned is None else len(owned)}/256)"
                     )
             except Exception:

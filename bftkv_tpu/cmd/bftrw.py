@@ -9,7 +9,8 @@ Capability parity with the reference user tool
     bftrw --home /tmp/keys/u01 read   x               [--password pw]
     bftrw --home /tmp/keys/u01 ca     <caname> --key ca.pkcs8
     bftrw --home /tmp/keys/u01 sign   <caname> --in tbs.bin --algo rsa --hash sha256
-    bftrw --home /tmp/keys/u01 kms    <caname> --password pw   # random key, stored wrapped
+    bftrw --home /tmp/keys/u01 kms    <caname> --password pw   # random key,
+                                                               # stored wrapped
     bftrw --home /tmp/keys/u01 getkey <caname> <name> --password pw
 
 ``ca`` deals a private key to the quorum as threshold shares;

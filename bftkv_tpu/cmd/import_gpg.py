@@ -641,7 +641,7 @@ def main(argv: list[str] | None = None) -> int:
             f"({len(res.secrets)} with secret keys), "
             f"{len(res.edges)} trust edges re-signed natively, "
             f"{len(res.unconverted)} edges unconverted "
-            f"(signer secret key not among the imported homedirs)"
+            "(signer secret key not among the imported homedirs)"
         )
         for path in written:
             print(f"  wrote {path}")

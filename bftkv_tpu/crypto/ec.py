@@ -55,7 +55,10 @@ class Curve:
         k %= self.n
         acc = None
         for bit in bin(k)[2:]:
-            acc = None if acc is None else _from_jac(self, _jac_double(self, _to_jac(acc)))
+            acc = (
+                None if acc is None
+                else _from_jac(self, _jac_double(self, _to_jac(acc)))
+            )
             if bit == "1":
                 acc = self.add(acc, pt)
         return acc

@@ -23,12 +23,12 @@ from __future__ import annotations
 
 import hashlib
 import hmac
-import os
 import secrets as pysecrets
 from dataclasses import dataclass
 
 from bftkv_tpu.crypto import rng
 from bftkv_tpu.crypto import ec
+from bftkv_tpu import flags
 
 __all__ = [
     "ECPublicKey",
@@ -177,7 +177,7 @@ def sign_batch(messages: list[bytes], key: ECPrivateKey) -> list[bytes]:
         return []
     n = key.curve.n
     threshold = int(
-        os.environ.get("BFTKV_EC_SIGN_THRESHOLD", SIGN_HOST_CROSSOVER)
+        flags.raw("BFTKV_EC_SIGN_THRESHOLD", SIGN_HOST_CROSSOVER)
     )
     if len(messages) < threshold:
         return [sign(m, key) for m in messages]
@@ -237,7 +237,7 @@ def verify_batch(items: list[tuple[bytes, bytes, ECPublicKey]]) -> list[bool]:
     if not items:
         return []
     threshold = int(
-        os.environ.get("BFTKV_EC_VERIFY_THRESHOLD", VERIFY_HOST_CROSSOVER)
+        flags.raw("BFTKV_EC_VERIFY_THRESHOLD", VERIFY_HOST_CROSSOVER)
     )
     if len(items) < threshold:
         out = []

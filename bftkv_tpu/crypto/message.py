@@ -51,7 +51,6 @@ from __future__ import annotations
 import hashlib
 import io
 import struct
-import threading
 from collections import OrderedDict
 
 from bftkv_tpu.crypto import cert as certmod
@@ -67,6 +66,7 @@ from bftkv_tpu.errors import (
 )
 from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.packet import read_chunk, write_chunk
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 # The host ``cryptography`` library accelerates the RSA-OAEP key wrap
 # when present; without it (the jax_graft image does not bake it in)
@@ -207,7 +207,7 @@ class MessageSecurity:
         self._priv = (
             None if self._is_ec or _crsa is None else _private(key)
         )
-        self._lock = threading.Lock()
+        self._lock = named_lock("crypto.sessions")
         # peer id -> _SessionOut (how I encrypt *to* that peer)
         self._by_peer: "OrderedDict[int, _SessionOut]" = OrderedDict()
         # session id -> _SessionIn (how I decrypt *from* its peer)

@@ -35,12 +35,12 @@ quorum corrects, sessions are transport plumbing.
 
 from __future__ import annotations
 
-import os
 import threading
-import time
 from collections import OrderedDict
 
 from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu import flags
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = ["Presession", "enabled"]
 
@@ -48,7 +48,7 @@ MAX_UINT64 = 2**64 - 1
 
 
 def enabled() -> bool:
-    return os.environ.get("BFTKV_PRESESSION", "on").lower() not in (
+    return flags.raw("BFTKV_PRESESSION", "on").lower() not in (
         "off", "0", "false",
     )
 
@@ -64,7 +64,7 @@ class Presession:
     def __init__(self, client, *, interval: float = 5.0):
         self.client = client
         self.interval = interval
-        self._lock = threading.Lock()
+        self._lock = named_lock("crypto.presession")
         self._leases: "OrderedDict[bytes, int]" = OrderedDict()
         # id(quorum) -> (quorum strong ref, {signer id: cert}); the
         # strong ref pins the id so a recycled address can never alias.

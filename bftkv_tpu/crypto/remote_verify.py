@@ -27,7 +27,6 @@ from __future__ import annotations
 import hmac
 import socket
 import struct
-import threading
 import time
 
 import numpy as np
@@ -41,6 +40,7 @@ from bftkv_tpu.cmd.verify_sidecar import (
 from bftkv_tpu.crypto import cert as certmod
 from bftkv_tpu.crypto import rsa
 from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = ["RemoteVerifierDomain"]
 
@@ -77,7 +77,7 @@ class RemoteVerifierDomain:
             self._addr = (host or "127.0.0.1", int(port))
         self._timeout = timeout
         self._secret = secret
-        self._lock = threading.Lock()
+        self._lock = named_lock("crypto.remote_verify")
         self._sock: socket.socket | None = None
         self._skip_until = 0.0
         self.local = local or rsa.VerifierDomain(host_threshold=1 << 30)

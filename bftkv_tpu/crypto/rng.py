@@ -22,10 +22,12 @@ from __future__ import annotations
 import hashlib
 import os
 import threading
+from bftkv_tpu import flags
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = ["generate_random"]
 
-_OS_RNG = os.environ.get("BFTKV_OS_RNG", "") == "1"
+_OS_RNG = flags.raw("BFTKV_OS_RNG", "") == "1"
 _RESEED_BYTES = 1 << 20
 
 _local = threading.local()
@@ -34,7 +36,7 @@ _local = threading.local()
 # OS) instead of each calling ``os.urandom``: a fan-out burst spawning
 # dozens of pool workers would otherwise pay one GIL-dropping syscall
 # per thread right at the burst's latency-critical start.
-_master_lock = threading.Lock()
+_master_lock = named_lock("crypto.rng")
 _master_key: bytes | None = None
 _master_counter = 0
 _master_pid = 0

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 import hashlib
 import logging
-import threading
 from collections import OrderedDict
 from dataclasses import dataclass
 
@@ -20,6 +19,8 @@ import numpy as np
 from bftkv_tpu.errors import ERR_INVALID_SIGNATURE
 from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.ops import bigint, limb
+from bftkv_tpu import flags
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 log = logging.getLogger("bftkv_tpu.crypto.rsa")
 
@@ -254,7 +255,7 @@ def _load_native_modexp():
     import subprocess
     import sysconfig
 
-    if os.environ.get("BFTKV_NATIVE_MODEXP", "auto") == "off":
+    if flags.raw("BFTKV_NATIVE_MODEXP", "auto") == "off":
         return None
     nd = os.path.abspath(
         os.path.join(os.path.dirname(__file__), "..", "..", "native")
@@ -396,7 +397,7 @@ class SignerDomain:
         ops.enable_compile_cache()
         if host_threshold is None:
             host_threshold = int(
-                os.environ.get("BFTKV_HOST_SIGN_THRESHOLD", self.HOST_CROSSOVER)
+                flags.raw("BFTKV_HOST_SIGN_THRESHOLD", self.HOST_CROSSOVER)
             )
         self.host_threshold = host_threshold
         #: "rns" (default): windowed modexp in the residue number
@@ -404,7 +405,7 @@ class SignerDomain:
         #: large batch; "limb": the XLA Montgomery limb kernel.  Keys
         #: the RNS path cannot take fall back to the limb kernel, then
         #: to host.
-        self.backend = backend or os.environ.get("BFTKV_SIGN_BACKEND", "rns")
+        self.backend = backend or flags.raw("BFTKV_SIGN_BACKEND", "rns")
         if self.backend not in ("rns", "limb"):
             raise ValueError(f"unknown sign backend {self.backend!r}")
         self._doms: "OrderedDict[int, bigint.MontgomeryDomain | None]" = (
@@ -413,7 +414,7 @@ class SignerDomain:
         # key.n -> (dp, dq, qinv): one server signs every share with one
         # key, so these per-key constants must not be recomputed per item.
         self._crt: "OrderedDict[int, tuple[int, int, int]]" = OrderedDict()
-        self._dom_lock = threading.Lock()
+        self._dom_lock = named_lock("crypto.rsa.montgomery")
 
     _CACHE_MAX = 1024  # distinct private keys in one trust domain: few
 
@@ -702,7 +703,7 @@ class VerifierDomain:
         self.nlimbs = nlimbs
         if host_threshold is None:
             host_threshold = int(
-                os.environ.get("BFTKV_HOST_VERIFY_THRESHOLD", self.HOST_CROSSOVER)
+                flags.raw("BFTKV_HOST_VERIFY_THRESHOLD", self.HOST_CROSSOVER)
             )
         self.host_threshold = host_threshold
         #: "rns" (default): residue-number-system f32/MXU kernel, ~19x
@@ -710,7 +711,7 @@ class VerifierDomain:
         #: limb kernel; "pallas": the VMEM-resident limb chain. Hostile
         #: keys the RNS path cannot take (shared factor with a channel
         #: prime, etc.) fall back per item.
-        self.backend = backend or os.environ.get("BFTKV_VERIFY_BACKEND", "rns")
+        self.backend = backend or flags.raw("BFTKV_VERIFY_BACKEND", "rns")
         if self.backend not in ("rns", "limb", "pallas"):
             raise ValueError(f"unknown verify backend {self.backend!r}")
         self._cache: "OrderedDict[int, bigint.MontgomeryDomain | None]" = (
@@ -718,7 +719,7 @@ class VerifierDomain:
         )
         # Pipelined dispatcher flushes call verify_batch from multiple
         # worker threads; the LRU mutations must not race.
-        self._cache_lock = threading.Lock()
+        self._cache_lock = named_lock("crypto.rsa.verify_cache")
 
     def _dom(self, n: int) -> bigint.MontgomeryDomain | None:
         """Montgomery domain for ``n``, or None if ``n`` is unusable.

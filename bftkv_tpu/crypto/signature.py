@@ -34,7 +34,6 @@ from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.packet import (
     SIGNATURE_TYPE_NATIVE,
     SignaturePacket,
-    read_chunk,
     write_chunk,
 )
 

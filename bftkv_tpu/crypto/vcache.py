@@ -45,11 +45,11 @@ mathematics; trust decisions stay with each caller's keyring/quorum.
 from __future__ import annotations
 
 import hashlib
-import os
-import threading
 from collections import OrderedDict
 
 from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu import flags
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = [
     "VerifyCache",
@@ -99,7 +99,7 @@ class VerifyCache:
 
     def __init__(self, maxsize: int = 65536):
         self.maxsize = maxsize
-        self._lock = threading.Lock()
+        self._lock = named_lock("crypto.vcache")
         self._entries: "OrderedDict[tuple, bool]" = OrderedDict()
         # signer id -> set of entry keys, for O(entries-of-signer)
         # revocation eviction.
@@ -171,10 +171,10 @@ class VerifyCache:
 #: Process-global instance; ``BFTKV_VERIFY_CACHE=0`` disables all
 #: consultation and seeding, ``BFTKV_VERIFY_CACHE_MAX`` sizes it.
 cache = VerifyCache(
-    maxsize=int(os.environ.get("BFTKV_VERIFY_CACHE_MAX", "65536") or 65536)
+    maxsize=int(flags.raw("BFTKV_VERIFY_CACHE_MAX", "65536") or 65536)
 )
 
-_ENABLED = os.environ.get("BFTKV_VERIFY_CACHE", "1") != "0"
+_ENABLED = flags.raw("BFTKV_VERIFY_CACHE", "1") != "0"
 
 
 def enabled() -> bool:

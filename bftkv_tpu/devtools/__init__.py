@@ -1,0 +1,8 @@
+"""Development-time correctness tooling that ships inside the package
+(so deployments can arm it) but stays zero-overhead when disarmed:
+
+- :mod:`bftkv_tpu.devtools.lockwatch` — the opt-in runtime lock
+  sanitizer behind ``BFTKV_LOCKWATCH=1`` (DESIGN.md §16).
+
+The static half of the correctness plane lives in ``tools/bftlint``.
+"""

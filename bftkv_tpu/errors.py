@@ -18,7 +18,7 @@ Capability parity with the reference's interned error map
 
 from __future__ import annotations
 
-import threading
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 
 def _message_of(obj: object) -> str | None:
@@ -69,7 +69,7 @@ class Error(Exception, metaclass=_ErrorMeta):
 
 
 _registry: dict[str, type[Error]] = {}
-_lock = threading.Lock()
+_lock = named_lock("errors.intern")
 
 
 def new_error(message: str) -> type[Error]:

@@ -39,13 +39,13 @@ reports them) but only safety violations fail a run.
 
 from __future__ import annotations
 
-import threading
 from typing import Iterable
 
 from bftkv_tpu import packet as pkt
 from bftkv_tpu import quorum as qm
 from bftkv_tpu.protocol import MAX_UINT64
 from bftkv_tpu.sync.digest import HIDDEN_PREFIX
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = [
     "Event",
@@ -80,7 +80,7 @@ class HistoryRecorder:
     """Thread-safe append-only history; one global sequence."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("faults.checker")
         self._events: list[Event] = []
         self._seq = 0
 
@@ -296,7 +296,7 @@ class SafetyChecker:
             if not self._value_is_backed(servers, e.variable, e.value):
                 out.append(
                     f"read of {e.variable!r} returned {e.value!r} with no "
-                    f"verifiable collective signature at any honest replica"
+                    "verifiable collective signature at any honest replica"
                 )
         return out
 
@@ -429,6 +429,6 @@ class SafetyChecker:
                     out.append(
                         f"variable {var!r} committed certified values in "
                         f"{len(shards)} shards {sorted(shards)} with no "
-                        f"routing change to explain migration"
+                        "routing change to explain migration"
                     )
         return out

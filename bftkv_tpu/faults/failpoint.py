@@ -31,11 +31,11 @@ metrics labeled by (point, action) — both closed enums.
 from __future__ import annotations
 
 import hashlib
-import threading
 from collections import deque
-from typing import Callable, NamedTuple
+from typing import NamedTuple
 
 from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = [
     "ARMED",
@@ -165,7 +165,7 @@ class FaultRegistry:
     TRACE_MAX = 65536
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("faults.registry")
         self._rules: dict[str, list[Rule]] = {}
         self._seed = 0
         self._seq = 0

@@ -979,11 +979,24 @@ def main(argv: list[str] | None = None) -> int:
         )
     finally:
         cluster.stop()
+    # Lock-order chaos soak (DESIGN.md §16): with BFTKV_LOCKWATCH=1 the
+    # whole schedule ran under the runtime lock sanitizer — any cycle in
+    # the acquisition-order graph or blocking call under a watched lock
+    # fails the soak exactly like a safety violation.
+    from bftkv_tpu.devtools import lockwatch
+
+    report["lockwatch"] = (
+        lockwatch.report() if lockwatch.enabled() else None
+    )
+    lockwatch_msg = (
+        lockwatch.fail_message() if lockwatch.enabled() else None
+    )
     failed = bool(
         report["violations"]
         or not report["converged"]
         or report["undetected"]
         or report["gray_blocked"]
+        or lockwatch_msg
     )
     if args.json:
         print(json.dumps(report, indent=2, default=repr))
@@ -1036,9 +1049,14 @@ def main(argv: list[str] | None = None) -> int:
     if report["gray_blocked"]:
         print("nemesis: GRAY MEMBER BLOCKED COMMITS")
         return 1
+    if lockwatch_msg:
+        print(lockwatch_msg)
+        print("nemesis: LOCKWATCH FINDINGS (cycle or I/O under lock)")
+        return 1
     print(
         "nemesis: ok (zero safety violations; every fault window "
-        "visible in the health feed; no gray member blocked a commit)"
+        "visible in the health feed; no gray member blocked a commit"
+        + ("; lockwatch clean)" if lockwatch.enabled() else ")")
     )
     return 0
 

@@ -21,12 +21,12 @@ proof-gated value prooflessly); gateway.py filters them before ``put``.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import OrderedDict
 
 from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.sync.digest import bucket_of
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = ["CertifiedCache"]
 
@@ -44,7 +44,7 @@ class CertifiedCache:
     def __init__(self, max_entries: int = 65536, ttl: float = 30.0):
         self.max_entries = max_entries
         self.ttl = ttl
-        self._lock = threading.Lock()
+        self._lock = named_lock("gateway.cache")
         self._od: "OrderedDict[bytes, _Entry]" = OrderedDict()
         self._buckets: dict[int, set[bytes]] = {}
 

@@ -25,7 +25,6 @@ has against a direct reader.
 from __future__ import annotations
 
 import hashlib
-import threading
 from collections import OrderedDict
 
 from bftkv_tpu import packet as pkt
@@ -34,6 +33,7 @@ from bftkv_tpu import trace
 from bftkv_tpu import transport as tp
 from bftkv_tpu.errors import ERR_UNCERTIFIED_RECORD, Error
 from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = ["GatewayClient", "GatewayPeer"]
 
@@ -86,7 +86,7 @@ class GatewayClient:
         # Content-addressed, so it can never validate different bytes;
         # bounded LRU, so a hostile gateway can at worst evict entries.
         self._verified: "OrderedDict[bytes, None]" = OrderedDict()
-        self._verified_lock = threading.Lock()
+        self._verified_lock = named_lock("gateway.client.verified")
 
     _VERIFIED_MAX = 4096
 

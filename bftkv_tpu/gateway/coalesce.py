@@ -29,6 +29,7 @@ import threading
 
 from bftkv_tpu import trace
 from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = ["WriteCoalescer"]
 
@@ -53,7 +54,7 @@ class WriteCoalescer:
         self.client = client
         self.linger = self.LINGER if linger is None else linger
         self._q: "queue.SimpleQueue[_Waiter]" = queue.SimpleQueue()
-        self._lock = threading.Lock()
+        self._lock = named_lock("gateway.coalesce")
         self._thread: threading.Thread | None = None
         self._stopped = False
 

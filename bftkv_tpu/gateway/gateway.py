@@ -44,6 +44,7 @@ from bftkv_tpu.gateway.coalesce import WriteCoalescer
 from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.protocol.client import Client
 from bftkv_tpu.protocol.server import HIDDEN_PREFIX
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = ["AdmissionQueue", "Gateway"]
 
@@ -146,7 +147,7 @@ class Gateway:
         self.cache = CertifiedCache(cache_max, cache_ttl)
         self.coalescer = WriteCoalescer(self.client, linger=linger)
         self.admission = AdmissionQueue(max_inflight, max_queue)
-        self._fill_lock = threading.Lock()
+        self._fill_lock = named_lock("gateway.fill")
         self._fills: dict[bytes, threading.Event] = {}
         # Per-INSTANCE observability counters for /info: the process
         # metrics registry is shared tier-wide in one process, so

@@ -30,11 +30,11 @@ boolean reductions on device (``bftkv_tpu.ops.tally``) — the
 from __future__ import annotations
 
 import logging
-import threading
 from collections import deque
 from dataclasses import dataclass, field
 
 import numpy as np
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 log = logging.getLogger("bftkv_tpu.graph")
 
@@ -65,7 +65,7 @@ class Graph:
         # the bump is locked — a lost increment would let a stale cached
         # quorum survive a membership change.
         self.generation = 0
-        self._gen_lock = threading.Lock()
+        self._gen_lock = named_lock("graph.generation")
         # Operator-local trust edges (add_local_edges): present in
         # ``Vertex.edges`` for quorum traversal but excluded from shard
         # clique enumeration — they exist in THIS view only, and the

@@ -35,8 +35,33 @@ import re
 import threading
 import time
 from collections import defaultdict
+from bftkv_tpu.devtools.lockwatch import named_lock
 
-__all__ = ["BUCKETS", "Metrics", "histogram_quantile", "registry"]
+__all__ = [
+    "BUCKETS", "LABEL_KEYS", "Metrics", "histogram_quantile", "registry",
+]
+
+#: The CLOSED enum of label keys any instrument may carry.  Labels are
+#: low-cardinality dimensions only (DESIGN.md §7); the key vocabulary
+#: itself is fixed here so ``tools/bftlint``'s ``label-enum`` rule can
+#: statically reject a call site inventing a new dimension (the
+#: runtime cardinality tests bound the VALUES, this bounds the keys).
+#: Adding a key is a deliberate schema change: extend this tuple and
+#: document the dimension in DESIGN.md §7.
+LABEL_KEYS = (
+    "transport",  # backend: http / loop / visual / ws
+    "side",       # client / server
+    "cmd",        # protocol command name (closed command enum)
+    "shard",      # shard index (int, < shard count)
+    "op",         # gateway operation: read / write
+    "point",      # failpoint name (closed hook-site enum)
+    "action",     # failpoint action kind
+    "endpoint",   # daemon API endpoint (closed set + "other")
+    "peer",       # normalized link name (bounded by fleet size)
+    "event",      # visual/ws event type
+    "kind",       # autopilot plan kind: split / retire
+    "le",         # histogram bucket bound (fixed BUCKETS ladder)
+)
 
 #: Fixed histogram bucket upper bounds, IDENTICAL in every process so
 #: bucket counts sum across daemons.  The low end covers RPC/crypto
@@ -122,7 +147,7 @@ def _prom_value(v) -> str:
 
 class Metrics:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics")
         # Counters are sharded PER THREAD: ``incr`` is the hottest call
         # in the process (several per RPC from every handler, fan-out
         # worker and writer thread), and a single shared lock made each

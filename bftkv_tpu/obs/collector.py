@@ -40,6 +40,7 @@ from collections import deque
 
 from bftkv_tpu.metrics import BUCKETS, histogram_quantile
 from bftkv_tpu.obs.stitch import Stitcher
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = ["FleetCollector", "parse_flat_key"]
 
@@ -153,7 +154,7 @@ class FleetCollector:
         self.local_tracer = local_tracer
         self.fp_registry = fp_registry
         self.stitcher = Stitcher()
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.collector")
         self._anomalies: deque = deque(maxlen=max_anomalies)
         self._anomaly_seq = 0
         self._local_cursor = 0

@@ -18,8 +18,8 @@ collector without bound.
 
 from __future__ import annotations
 
-import threading
 from collections import OrderedDict
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = ["Stitcher"]
 
@@ -28,7 +28,7 @@ class Stitcher:
     def __init__(self, max_traces: int = 256, max_spans_per_trace: int = 512):
         self.max_traces = max_traces
         self.max_spans_per_trace = max_spans_per_trace
-        self._lock = threading.Lock()
+        self._lock = named_lock("obs.stitch")
         #: trace id (hex) -> {"spans": {span id: span dict}, "sources": set}
         self._traces: "OrderedDict[str, dict]" = OrderedDict()
 

@@ -15,6 +15,7 @@ Modules:
 import os as _os
 
 from bftkv_tpu.ops import bigint, limb  # noqa: F401
+from bftkv_tpu import flags
 
 
 def enable_compile_cache() -> None:
@@ -25,7 +26,7 @@ def enable_compile_cache() -> None:
     entirely.  ``BFTKV_COMPILE_CACHE`` overrides the location; an empty
     value disables.  Called lazily by every device entry point.
     """
-    path = _os.environ.get(
+    path = flags.raw(
         "BFTKV_COMPILE_CACHE",
         _os.path.expanduser("~/.cache/jax_bftkv"),
     )

@@ -44,6 +44,8 @@ import numpy as np
 from bftkv_tpu import trace
 from bftkv_tpu.faults import failpoint as fp
 from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu import flags
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = [
     "VerifyDispatcher",
@@ -62,7 +64,7 @@ __all__ = [
 ALWAYS_HOST = 1 << 30
 
 _CALIBRATION: dict | None = None
-_calibration_lock = threading.Lock()
+_calibration_lock = named_lock("dispatch.calibration")
 
 
 def calibration(force: bool = False) -> dict:
@@ -180,12 +182,11 @@ class _BatchDispatcher:
         pipeline: int | None = None,
         calibrate: bool | None = None,
     ):
-        import os
 
         self.max_batch = max_batch
         self.max_wait = max_wait
         if calibrate is None:
-            calibrate = os.environ.get("BFTKV_DISPATCH_CALIBRATE", "1") != "0"
+            calibrate = flags.raw("BFTKV_DISPATCH_CALIBRATE", "1") != "0"
         self._calibrate = calibrate
         #: True once install-time calibration decides the host beats a
         #: device launch at ANY batch this backend can see — call sites
@@ -193,13 +194,13 @@ class _BatchDispatcher:
         #: skip the collector wait + flush queue and run host inline.
         self._prefer_host = False
         if pipeline is None:
-            env = os.environ.get("BFTKV_DISPATCH_PIPELINE")
+            env = flags.raw("BFTKV_DISPATCH_PIPELINE")
             pipeline = int(env) if env else None
         self.pipeline = max(1, pipeline) if pipeline is not None else None
         self._inflight: threading.BoundedSemaphore | None = None
         self._work: "queue.SimpleQueue[list[_Pending] | None]" | None = None
         self._workers: list[threading.Thread] = []
-        self._lock = threading.Lock()
+        self._lock = named_lock("dispatch.batcher")
         self._cv = threading.Condition(self._lock)
         self._queue: list[_Pending] = []
         self._queued_items = 0
@@ -459,12 +460,10 @@ class VerifyDispatcher(_BatchDispatcher):
     def start(self):
         super().start()
         if self._calibrate:
-            import os
-
             cal = calibration()
             # An explicit env threshold is the operator's word and
             # outranks the measurement.
-            if os.environ.get("BFTKV_HOST_VERIFY_THRESHOLD") is None:
+            if flags.raw("BFTKV_HOST_VERIFY_THRESHOLD") is None:
                 self.verifier.host_threshold = cal["verify_crossover"]
             self._prefer_host = cal["prefer_host"]
         return self
@@ -527,13 +526,11 @@ class SignDispatcher(_BatchDispatcher):
     def start(self):
         super().start()
         if self._calibrate:
-            import os
-
             cal = calibration()
             self._prefer_host = cal["prefer_host"]
             if (
                 cal["sign_crossover"] is not None
-                and os.environ.get("BFTKV_HOST_SIGN_THRESHOLD") is None
+                and flags.raw("BFTKV_HOST_SIGN_THRESHOLD") is None
             ):
                 # CPU backend: any flush that still lands here (e.g. a
                 # caller ignoring prefer_host) must host-sign rather
@@ -582,7 +579,7 @@ class SignDispatcher(_BatchDispatcher):
 
 _global: VerifyDispatcher | None = None
 _global_signer: SignDispatcher | None = None
-_global_lock = threading.Lock()
+_global_lock = named_lock("dispatch.install")
 
 
 def install(dispatcher: VerifyDispatcher | None = None) -> VerifyDispatcher:

@@ -28,6 +28,7 @@ from jax import lax
 
 from bftkv_tpu.crypto.ec import P256
 from bftkv_tpu.ops import bigint, limb
+from bftkv_tpu import flags
 
 __all__ = ["P256Domain", "p256"]
 
@@ -279,9 +280,8 @@ def _use_rns_backend() -> bool:
     or "auto" (default): RNS on a TPU backend — where the limb kernel's
     emulated integer multiplies are the round-3 bottleneck (556
     mults/s @ 64) — and limb on CPU."""
-    import os
 
-    mode = os.environ.get("BFTKV_EC_BACKEND", "auto")
+    mode = flags.raw("BFTKV_EC_BACKEND", "auto")
     if mode == "rns":
         return True
     if mode == "auto":

@@ -16,10 +16,10 @@ is LRU-bounded since moduli can be influenced by remote peers.
 from __future__ import annotations
 
 import logging
-import os
 from collections import OrderedDict
 
 import numpy as np
+from bftkv_tpu import flags
 
 __all__ = ["BatchModExp"]
 
@@ -30,7 +30,7 @@ class BatchModExp:
 
     def __init__(self, min_batch: int | None = None):
         if min_batch is None:
-            min_batch = int(os.environ.get("BFTKV_TPU_MIN_MODEXP_BATCH", "4"))
+            min_batch = int(flags.raw("BFTKV_TPU_MIN_MODEXP_BATCH", "4"))
         self.min_batch = min_batch
         self._domains: "OrderedDict[tuple[int, int], object]" = OrderedDict()
 

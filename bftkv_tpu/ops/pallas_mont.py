@@ -40,7 +40,6 @@ def _up_dyn(x: jnp.ndarray, s) -> jnp.ndarray:
     masked off. Shifts may legitimately reach W (the phi half-product of
     the top limb in mod-R space): the mask then zeroes everything.
     """
-    w = x.shape[1]
     rolled = pltpu.roll(x, s, axis=1)
     lane = lax.broadcasted_iota(jnp.int32, x.shape, 1)
     return jnp.where(lane >= s, rolled, 0)

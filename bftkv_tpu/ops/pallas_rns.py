@@ -38,7 +38,7 @@ from bftkv_tpu.ops import rns
 
 __all__ = ["pow_pallas", "verify_pallas", "TILE_POW", "TILE_VERIFY"]
 
-import os as _os
+from bftkv_tpu import flags
 
 #: Batch rows per grid step.  Budgeted against ~16 MB VMEM/core:
 #: the pow chain (kpad=128) holds its 16-entry window table (~4 MB at
@@ -54,7 +54,7 @@ def _tile_env(name: str, default: str) -> int:
     power-of-two so the callers' padded batches always divide it).
     Fail fast at import — a bad knob must not surface as a deep Mosaic
     error or a silent per-flush XLA fallback."""
-    raw = _os.environ.get(name, default)
+    raw = flags.raw(name, default)
     try:
         v = int(raw)
     except ValueError:

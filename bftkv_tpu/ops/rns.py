@@ -38,6 +38,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from bftkv_tpu.ops import limb
+from bftkv_tpu import flags
 
 __all__ = [
     "RNSContext",
@@ -733,7 +734,7 @@ def _use_pallas(env: str) -> bool:
     would be far slower than the XLA kernels, and on a multi-chip pool
     the sharded XLA path spreads the batch over every device (see
     :func:`_mesh`).  "pallas"/"xla" force."""
-    mode = os.environ.get(env, "auto")
+    mode = flags.raw(env, "auto")
     if mode == "pallas":
         return True
     if mode == "auto":
@@ -756,7 +757,7 @@ def _mesh():
     over the batch axis, so the dispatcher's launches shard across the
     replica's whole accelerator pool via ``shard_map`` — collectives
     stay strictly inside one replica's trust domain (SURVEY §5)."""
-    if os.environ.get("BFTKV_SHARD", "auto") == "off":
+    if flags.raw("BFTKV_SHARD", "auto") == "off":
         return None
     devs = jax.devices()
     if len(devs) < 2:

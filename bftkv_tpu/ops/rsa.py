@@ -56,5 +56,7 @@ def power_batch(
     rsa.go:140-178) and for TPA's 2048-bit DH (crypto/auth/auth.go).
     """
     b_mont = bigint.to_mont(base, r2, n, n_prime)
-    v_mont = bigint.mont_exp(b_mont, e, n, n_prime, jnp.broadcast_to(one_mont, b_mont.shape))
+    v_mont = bigint.mont_exp(
+        b_mont, e, n, n_prime, jnp.broadcast_to(one_mont, b_mont.shape)
+    )
     return bigint.from_mont(v_mont, n, n_prime)

@@ -27,6 +27,7 @@ import struct
 from dataclasses import dataclass
 
 from bftkv_tpu.errors import ERR_MALFORMED_REQUEST
+from bftkv_tpu import flags
 
 SIGNATURE_TYPE_NIL = 0
 SIGNATURE_TYPE_NATIVE = 1  # our compact cert/signature format
@@ -521,7 +522,7 @@ def _load_native_codec():
     import subprocess
     import sysconfig
 
-    if os.environ.get("BFTKV_NATIVE_CODEC", "auto") == "off":
+    if flags.raw("BFTKV_NATIVE_CODEC", "auto") == "off":
         return None
     nd = os.path.abspath(
         os.path.join(os.path.dirname(__file__), "..", "native")

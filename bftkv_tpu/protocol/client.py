@@ -56,13 +56,14 @@ __all__ = ["Client", "MAX_UINT64"]
 
 log = logging.getLogger("bftkv_tpu.protocol.client")
 
-import os as _os
+from bftkv_tpu import flags
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 #: Sign rounds fan out to a minimal sufficient prefix first (one
 #: private-key op saved per skipped replica per write); ``full``
 #: restores the reference's ask-everyone shape.
 _STAGED_SIGN_FANOUT = (
-    _os.environ.get("BFTKV_SIGN_FANOUT", "staged") != "full"
+    flags.raw("BFTKV_SIGN_FANOUT", "staged") != "full"
 )
 
 #: Round-collapsed writes: ONE WRITE_SIGN fan-out replaces the classic
@@ -70,7 +71,7 @@ _STAGED_SIGN_FANOUT = (
 #: acks, the client commits at the write threshold, and the combined
 #: signature back-fills on the async tail (DESIGN.md §12).
 #: ``BFTKV_PIGGYBACK=off`` restores the classic rounds.
-_PIGGYBACK = _os.environ.get("BFTKV_PIGGYBACK", "on").lower() not in (
+_PIGGYBACK = flags.raw("BFTKV_PIGGYBACK", "on").lower() not in (
     "off", "0", "false",
 )
 
@@ -100,12 +101,12 @@ def _interleave(a: list, b: list) -> list:
 #: write_many pipelining: at most this many chunk write-rounds in
 #: flight behind the caller thread's time+sign rounds (1 disables).
 _WRITE_PIPELINE_WINDOW = int(
-    _os.environ.get("BFTKV_WRITE_PIPELINE", "2") or 2
+    flags.raw("BFTKV_WRITE_PIPELINE", "2") or 2
 )
 #: Chunk floor — batches at or below this size stay monolithic, so the
 #: server-side device launches stay amortized.
 _WRITE_PIPELINE_CHUNK = int(
-    _os.environ.get("BFTKV_WRITE_CHUNK", "256") or 256
+    flags.raw("BFTKV_WRITE_CHUNK", "256") or 256
 )
 
 
@@ -382,7 +383,7 @@ class Client(Protocol):
         #: the back-fill coalescer; ``drain_tails`` quiesces both —
         #: benches, the chaos checker, and tests use it.
         self._tails: list[threading.Thread] = []
-        self._tails_lock = threading.Lock()
+        self._tails_lock = named_lock("client.tails")
         self._backfills = _BackfillCoalescer(self)
         #: Optional /fleet member-status hints for health-aware staging
         #: (``apply_fleet_snapshot``); the client's own breaker/latency
@@ -1833,6 +1834,9 @@ class Client(Protocol):
                                 errs[i] = None
                                 break
                             except Exception:
+                                # Share verifies under none of the
+                                # candidate quorums so far: try the
+                                # next; errs[i] stays set if all fail.
                                 continue
             except Exception:
                 # Verification machinery failing must not discard the
@@ -1987,7 +1991,7 @@ class Client(Protocol):
                     done_flag[0] = done
                     return done
                 except Exception:
-                    pass
+                    pass  # malformed/forged share: count the peer below
             failure.append(res.peer)
             return qa.reject(failure)
 
@@ -2169,6 +2173,8 @@ class Client(Protocol):
             try:
                 share = pkt.parse_signature(data)
             except Exception:
+                # Undecodable share from this node: skip it — the
+                # threshold check below decides sufficiency.
                 continue
             if share is None:
                 continue

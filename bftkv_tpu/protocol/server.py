@@ -29,13 +29,11 @@ requests.
 from __future__ import annotations
 
 import logging
-import threading
 import time
 from collections import OrderedDict
 
 from bftkv_tpu import packet as pkt
 from bftkv_tpu import quorum as qm
-from bftkv_tpu import storage as st
 from bftkv_tpu import trace
 from bftkv_tpu import transport as tp
 from bftkv_tpu.crypto import auth as authmod
@@ -65,6 +63,7 @@ from bftkv_tpu.errors import (
 )
 from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.protocol import MAX_UINT64, Protocol, Ref
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = ["Server", "HIDDEN_PREFIX", "MAX_UINT64"]
 
@@ -88,12 +87,12 @@ class Server(Protocol):
         self._auth: "OrderedDict[bytes, authmod.AuthServer]" = OrderedDict()
         self._auth_used: dict[bytes, float] = {}
         self._auth_attempts: "OrderedDict[bytes, int]" = OrderedDict()
-        self._auth_lock = threading.Lock()
+        self._auth_lock = named_lock("server.auth")
         # Anti-entropy digest tree (bftkv_tpu/sync), built lazily on the
         # first SYNC_DIGEST/SYNC_PULL; every persist marks it dirty so
         # digests stay incremental.
         self._sync = None
-        self._sync_lock = threading.Lock()
+        self._sync_lock = named_lock("server.sync")
 
     # -- anti-entropy plumbing (bftkv_tpu/sync) ---------------------------
 
@@ -159,6 +158,8 @@ class Server(Protocol):
                 raw = self.storage.read(variable, 0)
                 p = pkt.parse(raw)
             except Exception:
+                # Unreadable/undecodable record: not repair-eligible —
+                # the anti-entropy plane owns hostile storage bytes.
                 continue
             if p.sig is None or p.auth is not None:
                 continue
@@ -510,7 +511,7 @@ class Server(Protocol):
                     (v for v in versions(variable) if v < t), reverse=True
                 )
             except Exception:
-                pass
+                pass  # backend's versions() broken: bounded scan below
         return range(t - 1, max(0, t - 1024), -1)
 
     # -- sign (reference: server.go:189-284) ------------------------------
@@ -550,6 +551,8 @@ class Server(Protocol):
                         issuer = self._present(c)
                         break
             except Exception:
+                # Unparsable embedded chain: keep the presented issuer;
+                # the qcert check right below is the authority.
                 pass
         self._check_quorum_certificate(issuer)
 
@@ -907,6 +910,8 @@ class Server(Protocol):
                             issuer = self._present(c)
                             break
                 except Exception:
+                    # Unparsable embedded chain: keep the presented
+                    # issuer; the qcert check below is the authority.
                     pass
             self._check_quorum_certificate(issuer)
 
@@ -998,7 +1003,7 @@ class Server(Protocol):
             try:
                 cp = pkt.parse(self.storage.read(variable, v))
             except Exception:
-                continue
+                continue  # torn/alien bytes here: keep scanning older
             if cp.ss is not None and cp.ss.completed:
                 return cp.sig
         return None
@@ -1559,7 +1564,11 @@ class Server(Protocol):
                 metrics.incr("server.sign.ok")
 
         return pkt.serialize_results(
-            [r if r is not None else (_errstr(ERR_MALFORMED_REQUEST), b"") for r in results]
+            [
+                r if r is not None
+                else (_errstr(ERR_MALFORMED_REQUEST), b"")
+                for r in results
+            ]
         )
 
     def _batch_write(self, req: bytes, peer, sender) -> bytes:
@@ -1647,7 +1656,11 @@ class Server(Protocol):
             results[i] = (None, b"")
 
         return pkt.serialize_results(
-            [r if r is not None else (_errstr(ERR_MALFORMED_REQUEST), b"") for r in results]
+            [
+                r if r is not None
+                else (_errstr(ERR_MALFORMED_REQUEST), b"")
+                for r in results
+            ]
         )
 
     _handlers = {

@@ -28,13 +28,13 @@ from __future__ import annotations
 
 import hashlib
 import struct
-import threading
 from dataclasses import dataclass, field
 
 import numpy as np
 
 from bftkv_tpu import quorum as q
 from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 #: Keyspace routing granularity: ``sha256(x)[0]`` — deliberately the
 #: same bucketing as the anti-entropy digest tree
@@ -372,7 +372,7 @@ class WotQS:
         self.g = graph
         self._cache: dict[int, WotQuorum] = {}
         self._cache_gen: int | None = None
-        self._cache_lock = threading.Lock()
+        self._cache_lock = named_lock("quorum.cache")
         # Keyed-routing state, all memoized per graph generation under
         # the same guard discipline as ``_cache``:
         #   _topo       — shard cliques + bucket route table + complement

@@ -10,17 +10,17 @@ order — per-variable versions kept sorted so "latest" is O(1)
 from __future__ import annotations
 
 import bisect
-import threading
 
 from bftkv_tpu.errors import ERR_NOT_FOUND
 from bftkv_tpu.faults import failpoint as fp
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 
 class MemStorage:
     def __init__(self):
         # variable -> (sorted list of t, {t: value})
         self._data: dict[bytes, tuple[list[int], dict[int, bytes]]] = {}
-        self._lock = threading.Lock()
+        self._lock = named_lock("storage.mem")
 
     def read(self, variable: bytes, t: int = 0) -> bytes:
         with self._lock:

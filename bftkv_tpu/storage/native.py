@@ -11,16 +11,16 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 
 from bftkv_tpu.errors import ERR_NOT_FOUND, new_error
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 ERR_STORAGE_IO = new_error("storage I/O failure")
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
 _LIB_PATH = os.path.abspath(os.path.join(_NATIVE_DIR, "libbftkvstore.so"))
 _lib = None
-_lib_lock = threading.Lock()
+_lib_lock = named_lock("storage.native.lib")
 
 
 def _load() -> ctypes.CDLL:
@@ -84,7 +84,7 @@ class NativeStorage:
         if not handle:
             raise ERR_STORAGE_IO
         self._handle = handle
-        self._lock = threading.Lock()
+        self._lock = named_lock("storage.native")
 
     def close(self) -> None:
         with self._lock:

@@ -20,12 +20,15 @@ from collections import OrderedDict
 
 from bftkv_tpu.errors import ERR_NOT_FOUND
 from bftkv_tpu.faults import failpoint as fp
+from bftkv_tpu import flags
+from bftkv_tpu.devtools import lockwatch
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 
 class PlainStorage:
     def __init__(self, path: str, *, fsync: bool | None = None):
         self.path = path
-        self._lock = threading.Lock()
+        self._lock = named_lock("storage.plain")
         # The write *ordering* (temp + rename) is always on — a crash
         # can never publish a torn version.  The per-write fsync pair
         # (file + directory) is a durability policy: ~5 ms/write on
@@ -35,7 +38,7 @@ class PlainStorage:
         # deployment property, not a test-harness one.
         # BFTKV_PLAIN_FSYNC=1/0 overrides either way.
         if fsync is None:
-            env = os.environ.get("BFTKV_PLAIN_FSYNC", "")
+            env = flags.raw("BFTKV_PLAIN_FSYNC", "")
             fsync = env == "1"
         self.fsync = fsync
         # stem -> max stored t.  ``read(variable, 0)`` used to list the
@@ -56,7 +59,7 @@ class PlainStorage:
         # bytes a crash could lose that the file couldn't.
         # BFTKV_PLAIN_CACHE sizes it (entries; 0 disables).
         self._cache: "OrderedDict[tuple[str, int], bytes]" = OrderedDict()
-        self._cache_max = int(os.environ.get("BFTKV_PLAIN_CACHE", "1024") or 0)
+        self._cache_max = int(flags.raw("BFTKV_PLAIN_CACHE", "1024") or 0)
         os.makedirs(path, exist_ok=True)
 
     def _prefix(self, variable: bytes) -> str:
@@ -69,12 +72,23 @@ class PlainStorage:
         return variable.hex()
 
     def _index_locked(self) -> dict[str, int]:
-        """The latest-version index; caller holds the lock."""
+        """The latest-version index; caller holds the lock.
+
+        The FIRST-use rebuild lists the directory while holding the
+        lock — deliberately: ``write()`` only maintains the index when
+        it exists, so a rebuild racing a concurrent write outside the
+        lock could publish an index missing that write's version
+        forever.  One listing per process lifetime; lockwatch-waived
+        with that reason."""
         idx = self._latest
         if idx is None:
             idx = {}
             try:
-                names = os.listdir(self.path)
+                with lockwatch.waiver(
+                    "plain: one-time index rebuild must hold the store "
+                    "lock (write() skips index updates while it is None)"
+                ):
+                    names = os.listdir(self.path)
             except FileNotFoundError:
                 names = []
             for name in names:
@@ -185,25 +199,30 @@ class PlainStorage:
             self._cache_put_locked(stem, t, value)
 
     def versions(self, variable: bytes) -> list[int]:
-        """All stored timestamps for ``variable`` (ascending)."""
+        """All stored timestamps for ``variable`` (ascending).
+
+        No lock: the listing reads only the directory, data files are
+        never deleted, and renames are atomic — the store lock never
+        serialized the renames anyway (``_write_atomic`` runs outside
+        it), so holding it here bought nothing but a stall for every
+        concurrent handler (lockwatch finding, DESIGN.md §16)."""
         prefix = self._prefix(variable) + "."
         out = []
-        with self._lock:
-            try:
-                names = os.listdir(self.path)
-            except FileNotFoundError:
-                return out
-            for name in names:
-                if name.startswith(prefix) and not name.endswith(".tmp"):
-                    try:
-                        out.append(int(name[len(prefix) :]))
-                    except ValueError:
-                        continue
+        try:
+            names = os.listdir(self.path)
+        except FileNotFoundError:
+            return out
+        for name in names:
+            if name.startswith(prefix) and not name.endswith(".tmp"):
+                try:
+                    out.append(int(name[len(prefix) :]))
+                except ValueError:
+                    continue
         return sorted(out)
 
     def _inventory(self) -> dict[bytes, list[int]]:
-        """variable → timestamps, decoded from the directory listing;
-        caller holds the lock."""
+        """variable → timestamps, decoded from the directory listing.
+        Lock-free (see :meth:`versions`): touches no shared state."""
         try:
             names = os.listdir(self.path)
         except FileNotFoundError:
@@ -236,14 +255,12 @@ class PlainStorage:
 
     def keys(self) -> list[bytes]:
         """Every stored variable (storage contract — anti-entropy)."""
-        with self._lock:
-            return list(self._inventory())
+        return list(self._inventory())
 
     def scan(self) -> list[tuple[bytes, int]]:
         """Every stored ``(variable, t)`` pair, one directory walk."""
-        with self._lock:
-            return [
-                (var, t)
-                for var, ts in self._inventory().items()
-                for t in ts
-            ]
+        return [
+            (var, t)
+            for var, ts in self._inventory().items()
+            for t in ts
+        ]

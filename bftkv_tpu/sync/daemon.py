@@ -35,7 +35,6 @@ Metrics: ``sync.rounds``, ``sync.pull.records`` (admitted),
 from __future__ import annotations
 
 import logging
-import os
 import random
 import threading
 import time
@@ -47,6 +46,7 @@ from bftkv_tpu import transport as tp
 from bftkv_tpu.faults import failpoint as fp
 from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.sync.digest import HIDDEN_PREFIX, latest_completed
+from bftkv_tpu import flags
 
 __all__ = ["SyncDaemon", "admit_records", "repair_enabled"]
 
@@ -56,7 +56,7 @@ log = logging.getLogger("bftkv_tpu.sync")
 def repair_enabled() -> bool:
     """``BFTKV_REPAIR`` — the pending-residue repair plane (default
     on).  ``BFTKV_REPAIR_AFTER`` sets the grace window in seconds."""
-    return os.environ.get("BFTKV_REPAIR", "on").lower() not in (
+    return flags.raw("BFTKV_REPAIR", "on").lower() not in (
         "off", "0", "false",
     )
 
@@ -165,6 +165,8 @@ def admit_records(server, records: list[bytes]) -> dict:
                             metrics.incr("sync.pull.dual_verified")
                             break
                         except Exception:
+                            # Try the next dual-window quorum; verrs[j]
+                            # stays set when none verifies.
                             continue
     else:
         verrs = []
@@ -217,7 +219,7 @@ class SyncDaemon:
         self.jitter = jitter
         if repair_after is None:
             repair_after = float(
-                os.environ.get("BFTKV_REPAIR_AFTER", "5") or 5
+                flags.raw("BFTKV_REPAIR_AFTER", "5") or 5
             )
         #: Grace window: a pending record younger than this (measured
         #: from when THIS daemon first observed it — storage records
@@ -657,7 +659,7 @@ class SyncDaemon:
                 )
                 continue  # already vouched for by the owner quorum
             except Exception:
-                pass
+                pass  # not certified as-is: certify-or-demote below
             verdict, out = self._certify_record(variable, t, raw, p)
             if verdict == "certified":
                 stats["recertified"] += 1

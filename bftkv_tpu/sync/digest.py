@@ -34,7 +34,6 @@ from __future__ import annotations
 
 import hashlib
 import struct
-import threading
 
 from bftkv_tpu import packet as pkt
 from bftkv_tpu.errors import ERR_NOT_FOUND
@@ -43,6 +42,7 @@ from bftkv_tpu.errors import ERR_NOT_FOUND
 # never sync — the ONE sentinel the server defines, not a copy that
 # could silently diverge from it.
 from bftkv_tpu.protocol.server import HIDDEN_PREFIX
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = [
     "DigestTree",
@@ -96,6 +96,8 @@ def latest_completed(
         try:
             p = pkt.parse(raw)
         except Exception:
+            # Undecodable stored bytes: the digest skips them —
+            # hostile storage must not kill the sync round.
             continue
         if p.auth is not None:
             return None  # protected variable: not syncable at all
@@ -109,7 +111,7 @@ class DigestTree:
 
     def __init__(self, storage):
         self.storage = storage
-        self._lock = threading.Lock()
+        self._lock = named_lock("sync.digest")
         self._vars: dict[int, set[bytes]] = {}
         self._hashes: dict[int, bytes] = {}
         self._dirty: set[int] = set()

@@ -50,6 +50,8 @@ import random
 import threading
 import time
 from collections import deque
+from bftkv_tpu import flags
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = [
     "Span",
@@ -236,15 +238,15 @@ class Tracer:
         slow_threshold: float | None = None,
         max_slow: int = 64,
     ):
-        self.enabled = os.environ.get("BFTKV_TRACE", "on").lower() not in (
+        self.enabled = flags.raw("BFTKV_TRACE", "on").lower() not in (
             "off", "0", "false",
         )
         if slow_threshold is None:
             slow_threshold = float(
-                os.environ.get("BFTKV_SLOW_TRACE_SECONDS", "1.0")
+                flags.raw("BFTKV_SLOW_TRACE_SECONDS", "1.0")
             )
         self.slow_threshold = slow_threshold
-        self._lock = threading.Lock()
+        self._lock = named_lock("trace.collector")
         self._spans: "deque[Span]" = deque(maxlen=max_spans)
         self._slow: "deque[dict]" = deque(maxlen=max_slow)
         # Monotonic sequence of recorded spans — the export cursor.

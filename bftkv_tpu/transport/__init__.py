@@ -23,7 +23,6 @@ the crypto layer.
 
 from __future__ import annotations
 
-import os
 import queue
 import random
 import threading
@@ -36,11 +35,13 @@ from bftkv_tpu import trace
 from bftkv_tpu.errors import ERR_UNKNOWN_SESSION, new_error
 from bftkv_tpu.faults import failpoint as fp
 from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu import flags
 from bftkv_tpu.transport.latency import (
     adaptive_enabled,
     hedging_enabled,
     peer_latency,
 )
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = [
     "JOIN",
@@ -252,8 +253,8 @@ class RetryPolicy:
 #: Process default; a transport instance overrides with its own
 #: ``retry_policy`` attribute.
 default_retry_policy = RetryPolicy(
-    retries=int(os.environ.get("BFTKV_RPC_RETRIES", "0") or 0),
-    backoff=float(os.environ.get("BFTKV_RPC_BACKOFF", "0.05") or 0.05),
+    retries=int(flags.raw("BFTKV_RPC_RETRIES", "0") or 0),
+    backoff=float(flags.raw("BFTKV_RPC_BACKOFF", "0.05") or 0.05),
 )
 
 
@@ -277,7 +278,7 @@ class PeerHealth:
         self.threshold = threshold
         self.open_secs = open_secs
         self.enabled = enabled
-        self._lock = threading.Lock()
+        self._lock = named_lock("transport.breaker")
         # addr -> [consecutive_fails, open_until_monotonic]
         self._states: dict[str, list] = {}
 
@@ -345,9 +346,9 @@ class PeerHealth:
 
 
 peer_health = PeerHealth(
-    threshold=int(os.environ.get("BFTKV_PEER_CB_THRESHOLD", "3") or 3),
-    open_secs=float(os.environ.get("BFTKV_PEER_CB_OPEN_SECS", "5") or 5),
-    enabled=os.environ.get("BFTKV_PEER_CB", "") == "1",
+    threshold=int(flags.raw("BFTKV_PEER_CB_THRESHOLD", "3") or 3),
+    open_secs=float(flags.raw("BFTKV_PEER_CB_OPEN_SECS", "5") or 5),
+    enabled=flags.raw("BFTKV_PEER_CB", "") == "1",
 )
 
 
@@ -387,13 +388,13 @@ class _DaemonPool:
     def __init__(self, max_workers: int | None = None):
         if max_workers is None:
             max_workers = int(
-                os.environ.get("BFTKV_FANOUT_WORKERS", "256") or 256
+                flags.raw("BFTKV_FANOUT_WORKERS", "256") or 256
             )
         # SimpleQueue: C-implemented put/get — the shared Condition
         # machinery of queue.Queue was a measured lock convoy with ~100
         # workers contending one mutex.
         self._q: "queue.SimpleQueue[Callable[[], None]]" = queue.SimpleQueue()
-        self._lock = threading.Lock()
+        self._lock = named_lock("transport.pool.workers")
         self._idle = 0
         self._count = 0
         self._max = max_workers
@@ -639,7 +640,7 @@ def _inline_fanout_ok() -> bool:
     return True
 
 
-_INLINE_FANOUT = os.environ.get("BFTKV_INLINE_FANOUT", "auto")
+_INLINE_FANOUT = flags.raw("BFTKV_INLINE_FANOUT", "auto")
 
 
 def _multicast_inline(
@@ -700,6 +701,8 @@ def _multicast_inline(
                             tr, peers, mdata, j, ctx
                         )
                     except Exception:
+                        # Per-peer seal failure (no session, no cert):
+                        # skip the peer; quorum thresholds decide.
                         continue
                 addr = getattr(peer, "address", "")
                 if addr:
@@ -729,6 +732,10 @@ def _inject_send_fault(tr, url, data, name, addr, deadline=None):
     """``transport.send`` failpoint: per-link drop / delay / duplicate /
     corrupt.  Returns the (possibly corrupted) payload to post, or
     raises the injected transport error."""
+    if not fp.ARMED:
+        # Callers guard too; this local guard keeps the zero-overhead
+        # contract (no link_of/context construction) self-contained.
+        return data
     act = fp.fire(
         "transport.send",
         src=fp.link_of(getattr(tr, "link_id", "") or ""),
@@ -759,7 +766,7 @@ def _inject_send_fault(tr, url, data, name, addr, deadline=None):
         try:
             tr.post(url, data)
         except Exception:
-            pass
+            pass  # the duplicate's response is deliberately discarded
         return data
     return data
 

@@ -24,7 +24,8 @@ from bftkv_tpu.metrics import registry as metrics
 
 __all__ = ["TrHTTP", "MalTrHTTP", "default_rpc_timeout"]
 
-import os
+from bftkv_tpu import flags
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 CONNECT_TIMEOUT = 5.0
 # The reference pins 10 s (http.go:39-50); configurable because a
@@ -33,8 +34,8 @@ CONNECT_TIMEOUT = 5.0
 # BFTKV_RPC_TIMEOUT is the canonical knob (--rpc-timeout plumbs it);
 # BFTKV_HTTP_TIMEOUT stays honored for compatibility.
 RESPONSE_TIMEOUT = float(
-    os.environ.get("BFTKV_RPC_TIMEOUT")
-    or os.environ.get("BFTKV_HTTP_TIMEOUT")
+    flags.raw("BFTKV_RPC_TIMEOUT")
+    or flags.raw("BFTKV_HTTP_TIMEOUT")
     or "10"
 )
 NONCE_SIZE = 8
@@ -111,9 +112,9 @@ class _ConnPool:
 
     def __init__(self, per_peer: int | None = None):
         if per_peer is None:
-            per_peer = int(os.environ.get("BFTKV_HTTP_POOL", "4") or 4)
+            per_peer = int(flags.raw("BFTKV_HTTP_POOL", "4") or 4)
         self.per_peer = per_peer
-        self._lock = threading.Lock()
+        self._lock = named_lock("transport.pool")
         self._idle: dict[tuple[str, int], list[http.client.HTTPConnection]] = {}
         self._closed = False
 
@@ -153,7 +154,7 @@ class _ConnPool:
         try:
             conn.close()
         except Exception:
-            pass
+            pass  # over-quota idle socket: close is best-effort
 
     def close_all(self) -> None:
         with self._lock:
@@ -164,7 +165,7 @@ class _ConnPool:
             try:
                 c.close()
             except Exception:
-                pass
+                pass  # already-dead sockets close noisily on shutdown
 
 
 class TrHTTP:
@@ -257,7 +258,7 @@ class TrHTTP:
                 try:
                     conn.close()
                 except Exception:
-                    pass
+                    pass  # best-effort close; e is classified below
                 if _is_timeout(e):
                     raise tp.ERR_RPC_TIMEOUT from None
                 raise tp.ERR_SERVER_ERROR from None

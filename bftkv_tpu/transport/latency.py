@@ -43,12 +43,12 @@ order it asks (DESIGN.md §13).
 
 from __future__ import annotations
 
-import os
-import threading
 import time
 from collections import deque
 
 from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu import flags
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = [
     "PeerLatency",
@@ -59,7 +59,7 @@ __all__ = [
 
 
 def _flag(name: str, default: str = "on") -> bool:
-    return os.environ.get(name, default).lower() not in ("off", "0", "false")
+    return flags.raw(name, default).lower() not in ("off", "0", "false")
 
 
 def adaptive_enabled() -> bool:
@@ -124,16 +124,16 @@ class PeerLatency:
     GRAY_SECS = 10.0
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("transport.latency")
         self._peers: dict[str, _Peer] = {}
         self.floor = float(
-            os.environ.get("BFTKV_ADAPTIVE_FLOOR", "1.0") or 1.0
+            flags.raw("BFTKV_ADAPTIVE_FLOOR", "1.0") or 1.0
         )
         self.hedge_min = float(
-            os.environ.get("BFTKV_HEDGE_MIN", "0.02") or 0.02
+            flags.raw("BFTKV_HEDGE_MIN", "0.02") or 0.02
         )
         self.hedge_cap = float(
-            os.environ.get("BFTKV_HEDGE_CAP", "0.5") or 0.5
+            flags.raw("BFTKV_HEDGE_CAP", "0.5") or 0.5
         )
 
     def _peer(self, addr: str) -> _Peer:

@@ -27,6 +27,7 @@ import threading
 from bftkv_tpu import transport as tp
 from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.transport.http import TrHTTP
+from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = ["TrVisual", "WsHub"]
 
@@ -127,7 +128,7 @@ class WsHub(socketserver.ThreadingTCPServer):
         super().__init__(addr, _WsHandler)
         self.hub = self
         self._clients: set[socket.socket] = set()
-        self._lock = threading.Lock()
+        self._lock = named_lock("transport.visual")
         # Snapshot sources re-broadcast state (the trust graph) whenever
         # a client attaches, so late joiners see the current picture.
         self.on_attach: list = []
@@ -141,7 +142,7 @@ class WsHub(socketserver.ThreadingTCPServer):
             try:
                 cb()
             except Exception:
-                pass
+                pass  # an observer callback must never break the hub
 
     def detach(self, sock: socket.socket) -> None:
         with self._lock:

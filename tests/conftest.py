@@ -14,7 +14,25 @@ already-imported jax in-process.  The real-TPU lane opts out with
 
 import os
 
+import pytest
+
 if os.environ.get("BFTKV_TPU_LANE") != "1":
     from bftkv_tpu.hostcpu import force_cpu
 
     force_cpu(int(os.environ.get("BFTKV_TEST_DEVICES", "8")))
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _lockwatch_gate():
+    """The lockwatch pytest gate (DESIGN.md §16): with
+    ``BFTKV_LOCKWATCH=1`` the whole tier runs under the runtime lock
+    sanitizer, and any lock-order cycle or blocking-call-under-lock
+    recorded across the session fails it here.  Disarmed (the default)
+    this fixture is inert — ``named_lock`` returned plain stdlib locks
+    and nothing was recorded."""
+    yield
+    from bftkv_tpu.devtools import lockwatch
+
+    if lockwatch.enabled():
+        msg = lockwatch.fail_message()
+        assert msg is None, msg

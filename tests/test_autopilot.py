@@ -10,7 +10,7 @@ import pytest
 from bftkv_tpu import quorum as q
 from bftkv_tpu.autopilot import Autopilot, Plan, decide
 from bftkv_tpu.autopilot.plan import next_table
-from bftkv_tpu.quorum.wotqs import ROUTE_BUCKETS, route_bucket
+from bftkv_tpu.quorum.wotqs import ROUTE_BUCKETS
 
 
 # -- decisions (pure) -----------------------------------------------------

@@ -1,0 +1,350 @@
+"""tools/bftlint: every rule catches its planted violation, waivers
+suppress, the clean fixture passes, and HEAD itself lints clean.
+
+Fixtures are synthesized into a tmp tree shaped like the repo
+(``bftkv_tpu/protocol/...``) so the layer-scoped rules engage; the tmp
+tree gets the REAL registry modules (flags.py, metrics.py) copied in,
+so declared-flag and label-key extraction run against the genuine
+source of truth.
+"""
+
+import shutil
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from tools import bftlint
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture()
+def tree(tmp_path):
+    pkg = tmp_path / "bftkv_tpu"
+    (pkg / "protocol").mkdir(parents=True)
+    shutil.copy(REPO / "bftkv_tpu" / "flags.py", pkg / "flags.py")
+    shutil.copy(REPO / "bftkv_tpu" / "metrics.py", pkg / "metrics.py")
+    return tmp_path
+
+
+def lint(tree, source, rel="bftkv_tpu/protocol/fixture.py"):
+    p = tree / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return bftlint.lint_paths([str(p)], root=str(tree))
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# -- env-flag ---------------------------------------------------------------
+
+
+def test_env_flag_direct_read_caught(tree):
+    fs = lint(tree, """\
+        import os
+        v = os.environ.get("BFTKV_PIGGYBACK", "on")
+    """)
+    assert rules_of(fs) == ["env-flag"]
+
+
+def test_env_flag_subscript_and_getenv_caught(tree):
+    fs = lint(tree, """\
+        import os
+        a = os.environ["BFTKV_REPAIR"]
+        b = os.getenv("BFTKV_HEDGE")
+    """)
+    assert len(fs) == 2 and rules_of(fs) == ["env-flag"]
+
+
+def test_env_flag_undeclared_name_caught(tree):
+    fs = lint(tree, """\
+        from bftkv_tpu import flags
+        v = flags.raw("BFTKV_TOTALLY_NOT_DECLARED")
+    """)
+    assert rules_of(fs) == ["env-flag"]
+    assert "not declared" in fs[0].message
+
+
+def test_env_flag_declared_seam_read_clean(tree):
+    fs = lint(tree, """\
+        from bftkv_tpu import flags
+        v = flags.raw("BFTKV_PIGGYBACK", "on")
+        w = flags.enabled("BFTKV_REPAIR")
+    """)
+    assert fs == []
+
+
+# -- label-enum -------------------------------------------------------------
+
+
+def test_label_enum_bad_key_caught(tree):
+    fs = lint(tree, """\
+        from bftkv_tpu.metrics import registry as metrics
+        metrics.incr("server.thing", labels={"variable": "x"})
+    """)
+    assert rules_of(fs) == ["label-enum"]
+    assert "variable" in fs[0].message
+
+
+def test_label_enum_unresolvable_caught(tree):
+    fs = lint(tree, """\
+        from bftkv_tpu.metrics import registry as metrics
+        def f(labels):
+            metrics.incr("server.thing", labels=labels)
+    """)
+    assert rules_of(fs) == ["label-enum"]
+
+
+def test_label_enum_local_assignment_and_ifexp_clean(tree):
+    fs = lint(tree, """\
+        from bftkv_tpu.metrics import registry as metrics
+        def f(shard):
+            labels = {"shard": shard} if shard is not None else None
+            metrics.incr("server.thing", labels=labels)
+            metrics.observe("server.lat", 0.1, labels={"cmd": "write"})
+    """)
+    assert fs == []
+
+
+# -- failpoint-guard --------------------------------------------------------
+
+
+def test_failpoint_unguarded_caught(tree):
+    fs = lint(tree, """\
+        from bftkv_tpu.faults import failpoint as fp
+        def hook():
+            act = fp.fire("storage.write", backend="x")
+            return act
+    """)
+    assert rules_of(fs) == ["failpoint-guard"]
+
+
+def test_failpoint_guard_is_branch_sensitive(tree):
+    """A fire() on the DISARMED side of a guard must still flag: the
+    else branch of `if fp.ARMED:`, and code below an inverted
+    `if fp.ARMED: return` early return, both run exactly when
+    disarmed."""
+    fs = lint(tree, """\
+        from bftkv_tpu.faults import failpoint as fp
+        def hook_else():
+            if fp.ARMED:
+                pass
+            else:
+                fp.fire("storage.write", backend="x")
+        def hook_inverted_return(data):
+            if fp.ARMED:
+                return data
+            return fp.fire("transport.send", cmd="x")
+    """)
+    assert [f.rule for f in fs] == ["failpoint-guard", "failpoint-guard"]
+
+
+def test_failpoint_guarded_variants_clean(tree):
+    fs = lint(tree, """\
+        from bftkv_tpu.faults import failpoint as fp
+        def hook_if():
+            if fp.ARMED:
+                return fp.fire("storage.write", backend="x")
+        def hook_early_return(data):
+            if not fp.ARMED:
+                return data
+            act = fp.fire("transport.send", cmd="x")
+            return act or data
+    """)
+    assert fs == []
+
+
+# -- interned-error ---------------------------------------------------------
+
+
+def test_interned_error_runtime_error_caught(tree):
+    fs = lint(tree, """\
+        def handler():
+            raise RuntimeError("catastrophic wire failure")
+    """)
+    assert rules_of(fs) == ["interned-error"]
+
+
+def test_interned_error_dynamic_new_error_caught(tree):
+    fs = lint(tree, """\
+        from bftkv_tpu.errors import new_error
+        def decline(peer):
+            raise new_error(f"go away {peer}")
+    """)
+    assert rules_of(fs) == ["interned-error"]
+    assert "dynamic" in fs[0].message
+
+
+def test_interned_error_constant_clean(tree):
+    fs = lint(tree, """\
+        from bftkv_tpu.errors import new_error
+        ERR_X = new_error("transport: fixture error")
+        def decline():
+            raise ERR_X
+    """)
+    assert fs == []
+
+
+# -- swallowed-exception ----------------------------------------------------
+
+
+def test_bare_except_caught(tree):
+    fs = lint(tree, """\
+        def f():
+            try:
+                g()
+            except:
+                pass
+    """)
+    assert "swallowed-exception" in rules_of(fs)
+
+
+def test_broad_swallow_without_comment_caught(tree):
+    fs = lint(tree, """\
+        def f():
+            try:
+                g()
+            except Exception:
+                pass
+    """)
+    assert rules_of(fs) == ["swallowed-exception"]
+
+
+def test_swallow_with_comment_or_narrow_clean(tree):
+    fs = lint(tree, """\
+        from bftkv_tpu.errors import ERR_NOT_FOUND
+        def f():
+            try:
+                g()
+            except Exception:
+                pass  # best-effort cleanup: peer already gone
+            try:
+                g()
+            except ERR_NOT_FOUND:
+                pass
+    """)
+    assert fs == []
+
+
+# -- named-lock -------------------------------------------------------------
+
+
+def test_named_lock_direct_construction_caught(tree):
+    fs = lint(tree, """\
+        import threading
+        _lock = threading.Lock()
+    """)
+    assert rules_of(fs) == ["named-lock"]
+
+
+def test_named_lock_seam_clean(tree):
+    fs = lint(tree, """\
+        from bftkv_tpu.devtools.lockwatch import named_lock
+        _lock = named_lock("protocol.fixture")
+    """)
+    assert fs == []
+
+
+# -- waivers ----------------------------------------------------------------
+
+
+def test_waiver_suppresses_only_named_rule(tree):
+    fs = lint(tree, """\
+        import os
+        a = os.environ.get("BFTKV_PIGGYBACK")  # bftlint: ignore[env-flag] fixture
+        b = os.environ.get("BFTKV_REPAIR")
+    """)
+    assert len(fs) == 1 and fs[0].line == 3
+
+
+def test_waiver_on_preceding_line(tree):
+    fs = lint(tree, """\
+        import os
+        # bftlint: ignore[env-flag] fixture reason
+        a = os.environ.get("BFTKV_PIGGYBACK")
+    """)
+    assert fs == []
+
+
+# -- clean fixture + the real tree ------------------------------------------
+
+
+def test_clean_fixture_passes(tree):
+    fs = lint(tree, """\
+        from bftkv_tpu import flags
+        from bftkv_tpu.devtools.lockwatch import named_lock
+        from bftkv_tpu.errors import ERR_NOT_FOUND
+        from bftkv_tpu.faults import failpoint as fp
+        from bftkv_tpu.metrics import registry as metrics
+
+        _lock = named_lock("protocol.fixture")
+        _ON = flags.raw("BFTKV_PIGGYBACK", "on") != "off"
+
+        def handler(storage, variable):
+            if fp.ARMED:
+                fp.fire("storage.write", backend="fixture")
+            try:
+                raw = storage.read(variable, 0)
+            except ERR_NOT_FOUND:
+                return None
+            metrics.incr("server.reads", labels={"cmd": "read"})
+            return raw
+    """)
+    assert fs == []
+
+
+def test_head_lints_clean():
+    """The merged tree must stay bftlint-clean (the CI "Invariant
+    lint" step asserts the same from a named job)."""
+    findings = bftlint.lint_repo(str(REPO))
+    assert findings == [], "\n".join(f.render() for f in findings)
+
+
+def test_cli_exit_codes(tree, tmp_path):
+    bad = tree / "bftkv_tpu" / "protocol" / "bad.py"
+    bad.write_text('import os\nv = os.environ.get("BFTKV_PIGGYBACK")\n')
+    assert (
+        bftlint.main([str(bad), "--root", str(tree), "--json"]) == 1
+    )
+    good = tree / "bftkv_tpu" / "protocol" / "good.py"
+    good.write_text("x = 1\n")
+    assert bftlint.main([str(good), "--root", str(tree)]) == 0
+
+
+def test_cli_module_runs_clean_on_repo():
+    """`python -m tools.bftlint` — the exact CI invocation — exits 0
+    on HEAD and prints the clean banner."""
+    res = subprocess.run(
+        [sys.executable, "-m", "tools.bftlint"],
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "clean" in res.stdout
+
+
+def test_readme_freshness_check_detects_staleness(tmp_path):
+    (tmp_path / "bftkv_tpu").mkdir()
+    shutil.copy(
+        REPO / "bftkv_tpu" / "flags.py",
+        tmp_path / "bftkv_tpu" / "flags.py",
+    )
+    (tmp_path / "bftkv_tpu" / "__init__.py").write_text("")
+    from bftkv_tpu import flags as real_flags
+
+    stale = (
+        real_flags.README_BEGIN
+        + "\n| old table |\n"
+        + real_flags.README_END
+    )
+    (tmp_path / "README.md").write_text(stale)
+    fs = bftlint.check_readme(str(tmp_path))
+    assert len(fs) == 1 and fs[0].rule == "readme-flags"
+    (tmp_path / "README.md").write_text(
+        "# x\n\n" + real_flags.readme_table() + "\n"
+    )
+    assert bftlint.check_readme(str(tmp_path)) == []

@@ -10,7 +10,6 @@ import time
 import pytest
 
 from bftkv_tpu import topology
-from bftkv_tpu.errors import Error
 from bftkv_tpu.transport.loopback import TrLoopback
 
 from cluster_utils import start_cluster

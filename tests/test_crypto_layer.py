@@ -5,7 +5,6 @@ cert parse/sign/merge, detached sign/verify, collective combine until
 sufficient, sign-then-encrypt with nonce echo, symmetric data encryption.
 """
 
-import io
 
 import pytest
 

@@ -8,7 +8,6 @@ identities on random and adversarial inputs.
 
 import secrets
 
-import numpy as np
 import pytest
 
 from bftkv_tpu.crypto.ec import P256

@@ -68,7 +68,7 @@ def test_http_concurrent_writes_share_device_batches(http_cluster, monkeypatch):
 
     monkeypatch.setattr(vcache, "_ENABLED", False)
     metrics.reset()
-    d = dispatch.install(
+    dispatch.install(
         dispatch.VerifyDispatcher(max_batch=256, max_wait=0.01, calibrate=False)
     )
     try:

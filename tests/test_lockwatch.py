@@ -1,0 +1,255 @@
+"""devtools/lockwatch: cycle detection, blocking-call-under-lock,
+waivers, Condition compatibility, and the zero-overhead-disarmed
+contract.
+
+Tests that arm the sanitizer snapshot and restore its global state, so
+they compose with a fully-armed tier (``BFTKV_LOCKWATCH=1``) without
+planting their synthetic findings into the session gate.
+"""
+
+import threading
+import time
+
+import pytest
+
+from bftkv_tpu.devtools import lockwatch
+
+
+@pytest.fixture()
+def armed():
+    """Arm (if not already), snapshot findings state, restore after."""
+    was_armed = lockwatch.ARMED
+    saved_edges = dict(lockwatch._edges)
+    saved_blocking = dict(lockwatch._blocking)
+    saved_waived = dict(lockwatch._waived_orders)
+    if not was_armed:
+        lockwatch.arm()
+    else:
+        lockwatch.reset()
+    try:
+        yield
+    finally:
+        if not was_armed:
+            lockwatch.disarm()
+        with lockwatch._state_lock:
+            lockwatch._edges.clear()
+            lockwatch._edges.update(saved_edges)
+            lockwatch._blocking.clear()
+            lockwatch._blocking.update(saved_blocking)
+            lockwatch._waived_orders.clear()
+            lockwatch._waived_orders.update(saved_waived)
+
+
+# -- disarmed: zero overhead ------------------------------------------------
+
+
+def test_disarmed_returns_plain_stdlib_locks():
+    if lockwatch.ARMED:
+        pytest.skip("session runs armed (BFTKV_LOCKWATCH=1)")
+    lk = lockwatch.named_lock("test.plain")
+    # The contract is structural: no wrapper AT ALL — the exact class a
+    # direct threading.Lock() call returns, so the disarmed build is
+    # bit-for-bit the pre-lockwatch build on the lock hot path.
+    assert type(lk) is type(threading.Lock())
+    rlk = lockwatch.named_lock("test.plain.r", rlock=True)
+    assert type(rlk) is type(threading.RLock())
+
+
+def test_disarmed_perf_parity_smoke():
+    if lockwatch.ARMED:
+        pytest.skip("session runs armed (BFTKV_LOCKWATCH=1)")
+
+    def cycle(lock, n=20000):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            with lock:
+                pass
+        return time.perf_counter() - t0
+
+    plain = threading.Lock()
+    named = lockwatch.named_lock("test.parity")
+    # Identical classes, so any delta is box noise; median-of-5 with a
+    # generous bound keeps this meaningful without being flaky.
+    ratios = []
+    for _ in range(5):
+        p = cycle(plain)
+        m = cycle(named)
+        ratios.append(m / max(p, 1e-9))
+    ratios.sort()
+    assert ratios[2] < 2.0, ratios
+
+
+def test_disarmed_nothing_patched():
+    if lockwatch.ARMED:
+        pytest.skip("session runs armed (BFTKV_LOCKWATCH=1)")
+    import builtins
+
+    assert not hasattr(builtins.open, "__lockwatch_orig__")
+
+
+# -- armed: cycles ----------------------------------------------------------
+
+
+def test_ab_ba_cycle_detected(armed):
+    a = lockwatch.named_lock("test.cycle.a")
+    b = lockwatch.named_lock("test.cycle.b")
+    with a:
+        with b:
+            pass
+
+    def reverse():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=reverse)
+    t.start()
+    t.join()
+    rep = lockwatch.report()
+    assert ["test.cycle.a", "test.cycle.b", "test.cycle.a"] in rep[
+        "cycles"
+    ] or ["test.cycle.b", "test.cycle.a", "test.cycle.b"] in rep["cycles"]
+    assert lockwatch.fail_message() is not None
+
+
+def test_consistent_order_is_clean(armed):
+    a = lockwatch.named_lock("test.order.a")
+    b = lockwatch.named_lock("test.order.b")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    rep = lockwatch.report()
+    assert rep["cycles"] == []
+    assert "test.order.a->test.order.b" in rep["edges"]
+
+
+def test_three_party_cycle_detected(armed):
+    locks = {
+        n: lockwatch.named_lock(f"test.tri.{n}") for n in ("a", "b", "c")
+    }
+
+    def nest(first, second):
+        with locks[first]:
+            with locks[second]:
+                pass
+
+    for pair in (("a", "b"), ("b", "c")):
+        t = threading.Thread(target=nest, args=pair)
+        t.start()
+        t.join()
+    t = threading.Thread(target=nest, args=("c", "a"))
+    t.start()
+    t.join()
+    cycles = lockwatch.report()["cycles"]
+    assert any(len(c) == 4 for c in cycles), cycles
+
+
+def test_waive_order_excludes_edge(armed):
+    a = lockwatch.named_lock("test.waive.a")
+    b = lockwatch.named_lock("test.waive.b")
+    lockwatch.waive_order(
+        "test.waive.b", "test.waive.a", "test fixture: benign reverse"
+    )
+    with a:
+        with b:
+            pass
+
+    def reverse():
+        with b:
+            with a:
+                pass
+
+    t = threading.Thread(target=reverse)
+    t.start()
+    t.join()
+    rep = lockwatch.report()
+    assert rep["cycles"] == []
+    assert any(
+        w["order"] == ["test.waive.b", "test.waive.a"]
+        for w in rep["waived"]
+    )
+
+
+def test_reentrant_rlock_not_an_edge(armed):
+    r = lockwatch.named_lock("test.reentrant", rlock=True)
+    with r:
+        with r:
+            pass
+    assert lockwatch.report()["cycles"] == []
+
+
+# -- armed: blocking calls under watched locks ------------------------------
+
+
+def test_blocking_open_under_storage_lock_flagged(armed, tmp_path):
+    lk = lockwatch.named_lock("storage.test")
+    target = tmp_path / "x"
+    with lk:
+        with open(target, "w") as f:
+            f.write("hi")
+    blocking = lockwatch.report()["blocking"]
+    assert any(
+        b["lock"] == "storage.test" and b["func"] == "open"
+        for b in blocking
+    )
+    assert "blocking call under lock" in lockwatch.fail_message()
+
+
+def test_blocking_listdir_under_metrics_lock_flagged(armed, tmp_path):
+    import os
+
+    lk = lockwatch.named_lock("metrics")
+    with lk:
+        os.listdir(tmp_path)
+    blocking = lockwatch.report()["blocking"]
+    assert any(b["func"] == "os.listdir" for b in blocking)
+
+
+def test_blocking_outside_watched_classes_clean(armed, tmp_path):
+    lk = lockwatch.named_lock("transport.test.pool")
+    with lk:
+        (tmp_path / "y").write_text("ok")
+    assert lockwatch.report()["blocking"] == []
+
+
+def test_waiver_region_suppresses_blocking(armed, tmp_path):
+    lk = lockwatch.named_lock("storage.test2")
+    with lk:
+        with lockwatch.waiver("test fixture: known-benign one-time I/O"):
+            (tmp_path / "z").write_text("ok")
+    assert lockwatch.report()["blocking"] == []
+
+
+# -- armed: stdlib interop --------------------------------------------------
+
+
+def test_condition_wait_notify_over_named_lock(armed):
+    lk = lockwatch.named_lock("test.cv")
+    cv = threading.Condition(lk)
+    hits = []
+
+    def waiter():
+        with cv:
+            while not hits:
+                cv.wait(timeout=5)
+            hits.append("woke")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with cv:
+        hits.append("set")
+        cv.notify()
+    t.join(timeout=5)
+    assert "woke" in hits
+    assert lockwatch.report()["cycles"] == []
+
+
+def test_acquire_timeout_and_locked(armed):
+    lk = lockwatch.named_lock("test.api")
+    assert lk.acquire() is True
+    assert lk.locked()
+    assert lk.acquire(False) is False
+    lk.release()
+    assert not lk.locked()

@@ -139,9 +139,7 @@ def test_transport_rebootstraps_after_peer_restart(idents):
     """The multicast fan-out recovers transparently when the peer lost
     its session cache: ERR_UNKNOWN_SESSION → invalidate → bootstrap."""
     from bftkv_tpu import transport as tp
-    from bftkv_tpu.crypto import new_crypto
     from bftkv_tpu.protocol.server import Server
-    from bftkv_tpu.quorum.wotqs import WotQS
     from bftkv_tpu.storage.memkv import MemStorage
     from bftkv_tpu.transport.loopback import LoopbackNet, TrLoopback
 

@@ -42,8 +42,12 @@ def test_error_raise_and_catch():
 
 
 def test_roundtrip_full():
-    sig = packet.SignaturePacket(type=1, version=3, completed=True, data=b"sigdata", cert=b"certdata")
-    ss = packet.SignaturePacket(type=1, version=0, completed=False, data=b"ss", cert=None)
+    sig = packet.SignaturePacket(
+        type=1, version=3, completed=True, data=b"sigdata", cert=b"certdata"
+    )
+    ss = packet.SignaturePacket(
+        type=1, version=0, completed=False, data=b"ss", cert=None
+    )
     pkt = packet.serialize(b"var", b"value", 42, sig, ss, b"auth")
     p = packet.parse(pkt)
     assert p.variable == b"var"
@@ -102,7 +106,9 @@ def test_write_once_t():
 
 
 def test_signature_packet_roundtrip():
-    sig = packet.SignaturePacket(type=5, version=9, completed=True, data=b"d", cert=b"c")
+    sig = packet.SignaturePacket(
+        type=5, version=9, completed=True, data=b"d", cert=b"c"
+    )
     assert packet.parse_signature(packet.serialize_signature(sig)) == sig
     assert packet.parse_signature(packet.serialize_signature(None)) is None
 

@@ -19,7 +19,6 @@ import os
 import threading
 import time
 
-import pytest
 
 from bftkv_tpu.ops import dispatch
 from bftkv_tpu.storage.memkv import MemStorage

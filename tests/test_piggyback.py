@@ -15,7 +15,7 @@ import pytest
 from bftkv_tpu import packet as pkt
 from bftkv_tpu import quorum as qm
 from bftkv_tpu import transport as tp
-from bftkv_tpu.errors import Error, ERR_UNKNOWN_COMMAND
+from bftkv_tpu.errors import Error
 from bftkv_tpu.faults import failpoint as fp
 from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.protocol.server import Server

@@ -4,7 +4,6 @@ ONE per-peer reseal, never a whole-group OAEP bootstrap)."""
 
 from __future__ import annotations
 
-import pytest
 
 from bftkv_tpu.crypto.presession import MAX_UINT64, Presession
 from bftkv_tpu.faults.harness import build_cluster

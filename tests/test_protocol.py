@@ -13,10 +13,9 @@ from bftkv_tpu import topology
 from bftkv_tpu.errors import (
     ERR_INVALID_QUORUM_CERTIFICATE,
     ERR_INVALID_TIMESTAMP,
-    ERR_PERMISSION_DENIED,
     Error,
 )
-from bftkv_tpu.protocol.client import MAX_UINT64, Client
+from bftkv_tpu.protocol.client import Client
 from bftkv_tpu.transport.loopback import TrLoopback
 
 from cluster_utils import start_cluster

@@ -16,7 +16,6 @@ import pytest
 
 from bftkv_tpu import packet as pkt
 from bftkv_tpu import quorum as qm
-from bftkv_tpu import transport as tp
 from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.sync import SyncDaemon
 from bftkv_tpu.transport.latency import PeerLatency
@@ -142,7 +141,6 @@ def test_uncertifiable_residue_demoted_with_one_anomaly(cluster):
     """A planted record no quorum will ever endorse (its writer
     signature does not verify) is demoted — once — and surfaces as
     exactly one tail_starved anomaly in the fleet feed."""
-    from bftkv_tpu import trace as trmod
     from bftkv_tpu.obs import FleetCollector
 
     cl = cluster.clients[0]

@@ -1,4 +1,4 @@
-"""Repo hygiene: no orphaned bytecode in the package tree.
+"""Repo hygiene: no orphaned bytecode, and the BFTKV_* flag seam.
 
 The gateway prototype left six ``.pyc`` files in
 ``bftkv_tpu/gateway/__pycache__/`` whose source was never committed
@@ -26,3 +26,47 @@ def test_no_orphaned_bytecode():
         "bytecode without committed source (delete it or commit the "
         f"module): {orphans}"
     )
+
+
+def test_no_bftkv_flag_read_outside_flags_seam():
+    """Every ``BFTKV_*`` environment read in the package goes through
+    ``bftkv_tpu/flags.py`` (the registry seam): a raw ``os.environ`` /
+    ``getenv`` read of a ``BFTKV_*`` name anywhere else would ship an
+    undeclared, undocumented flag — the 48-vs-16 README drift this PR
+    closed.  tools/bftlint enforces the same rule with AST precision;
+    this source-level sweep keeps it self-enforcing even for code that
+    never crosses the lint step (and double-checks the linter)."""
+    import re
+
+    pkg = Path(bftkv_tpu.__file__).resolve().parent
+    pat = re.compile(
+        r"(?:environ(?:\.get)?\s*[\(\[]|getenv\s*\()\s*f?['\"]BFTKV_"
+    )
+    offenders = []
+    for py in pkg.rglob("*.py"):
+        if py.name == "flags.py" and py.parent == pkg:
+            continue
+        for i, line in enumerate(py.read_text().split("\n"), 1):
+            if pat.search(line):
+                offenders.append(f"{py.relative_to(pkg)}:{i}: {line.strip()}")
+    assert not offenders, (
+        "BFTKV_* flags must be read through the bftkv_tpu.flags seam "
+        "(declare in the registry, read via flags.raw/get/enabled):\n"
+        + "\n".join(offenders)
+    )
+
+
+def test_every_declared_flag_is_read_somewhere():
+    """The registry stays honest in the other direction too: a flag
+    declared in flags.py but referenced nowhere in the package is dead
+    documentation (either wire it up or delete the declaration)."""
+    from bftkv_tpu import flags
+
+    pkg = Path(bftkv_tpu.__file__).resolve().parent
+    blob = "\n".join(
+        py.read_text()
+        for py in pkg.rglob("*.py")
+        if not (py.name == "flags.py" and py.parent == pkg)
+    )
+    dead = [name for name in flags.declared() if name not in blob]
+    assert not dead, f"declared but never read anywhere: {dead}"

@@ -24,7 +24,7 @@ def test_sign_verify_host(keys):
 
 def test_sign_matches_cryptography_oracle(keys):
     pytest.importorskip("cryptography")  # oracle cross-check needs the host lib
-    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives import hashes
     from cryptography.hazmat.primitives.asymmetric import padding, rsa as crsa
 
     key = keys[0]
@@ -72,7 +72,6 @@ def test_verify_batch_oversize_sig(keys):
 
 
 def test_verify_batch_empty():
-    dom = rsa.VerifierDomain()
     assert rsa.VerifierDomain().verify_batch([]).shape == (0,)
 
 

@@ -13,7 +13,7 @@ import pytest
 
 from bftkv_tpu import quorum as q
 from bftkv_tpu.graph import Graph
-from bftkv_tpu.quorum.wotqs import ROUTE_BUCKETS, WotQS, route_bucket
+from bftkv_tpu.quorum.wotqs import ROUTE_BUCKETS, WotQS
 from tests.test_graph_quorum import FakeNode
 
 

@@ -153,7 +153,7 @@ def test_convergence_after_missed_writes(cluster):
     from bftkv_tpu.crypto import vcache as _vcache
     _was = _vcache._ENABLED
     _vcache._ENABLED = False
-    d = dispatch.install(
+    dispatch.install(
         dispatch.VerifyDispatcher(max_wait=0.001, calibrate=False)
     )
     try:

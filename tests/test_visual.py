@@ -9,7 +9,6 @@ import socket
 import struct
 import time
 
-import pytest
 
 from bftkv_tpu import topology
 from bftkv_tpu.protocol.client import Client
@@ -29,9 +28,9 @@ def _ws_connect(port: int) -> tuple[socket.socket, bytes]:
     key = base64.b64encode(os.urandom(16)).decode()
     s.sendall(
         (
-            f"GET / HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
+            "GET / HTTP/1.1\r\nHost: x\r\nUpgrade: websocket\r\n"
             f"Connection: Upgrade\r\nSec-WebSocket-Key: {key}\r\n"
-            f"Sec-WebSocket-Version: 13\r\n\r\n"
+            "Sec-WebSocket-Version: 13\r\n\r\n"
         ).encode()
     )
     resp = b""

@@ -1,0 +1,2 @@
+"""Repo tooling (``tools.bftlint`` runs as ``python -m tools.bftlint``;
+``bench_compare.py`` stays a plain script)."""

@@ -1,0 +1,744 @@
+"""bftlint — the project's AST invariant linter (zero dependencies).
+
+Ten PRs of DESIGN.md prose turned safety rules into reviewer memory:
+every ``BFTKV_*`` flag documented, metric labels from closed enums,
+failpoint hooks behind the module-bool guard, protocol errors interned,
+no silently swallowed exceptions, every lock through the ``named_lock``
+seam.  bftlint machine-checks each of them over the real source tree
+(``python -m tools.bftlint``), emits machine-readable findings
+(``--json``), and exits non-zero on any violation — CI runs it as the
+tier-1 "Invariant lint" step.  DESIGN.md §16 maps each rule to the PR
+whose prose it replaces.
+
+Waiver syntax, on the finding line or the line above::
+
+    something_flagged()  # bftlint: ignore[rule-name] why it is safe
+
+Rules (scoped in repo-walk mode; explicit file arguments get ALL rules,
+which is how the planted-violation fixtures in tests/ are checked):
+
+- ``env-flag`` — ``os.environ``/``os.getenv`` reads of a ``BFTKV_*``
+  literal outside ``bftkv_tpu/flags.py``, and ``flags.*`` reads of an
+  undeclared name, are rejected; every flag is declared once in the
+  registry with default + doc.
+- ``readme-flags`` — the README flags table must equal the one
+  generated from the registry (``python -m bftkv_tpu.flags --readme``).
+- ``label-enum`` — ``incr/observe/gauge(..., labels=)`` call sites may
+  only pass dict literals (directly or via a local single-hop
+  assignment) whose keys are members of ``metrics.LABEL_KEYS``.
+- ``failpoint-guard`` — every ``fire()`` eval site outside the faults
+  package sits behind the ``ARMED`` module-bool guard (the PR 3
+  disarmed-parity contract).
+- ``interned-error`` — protocol/transport/gateway/sync layers must not
+  raise bare ``Exception``/``RuntimeError`` (wire errors intern via
+  ``errors.new_error``), and ``new_error`` outside ``errors.py`` must
+  take a constant message (a dynamic message grows the intern registry
+  without bound).
+- ``swallowed-exception`` — bare ``except:`` anywhere; and on the
+  protocol/transport layers an ``except`` whose body is only
+  ``pass``/``continue`` must carry a comment saying WHY the swallow is
+  safe.
+- ``named-lock`` — ``threading.Lock()``/``RLock()`` construction in
+  the package goes through ``devtools.lockwatch.named_lock`` so the
+  lock sanitizer sees every lock.
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass
+
+__all__ = ["Finding", "lint_paths", "lint_repo", "main", "RULES"]
+
+RULES = (
+    "env-flag",
+    "readme-flags",
+    "label-enum",
+    "failpoint-guard",
+    "interned-error",
+    "swallowed-exception",
+    "named-lock",
+)
+
+#: Layers whose error/exception discipline is wire-facing.
+_PROTOCOL_LAYERS = (
+    "bftkv_tpu/protocol/",
+    "bftkv_tpu/transport/",
+    "bftkv_tpu/gateway/",
+    "bftkv_tpu/sync/",
+)
+
+_WAIVER_RE = re.compile(r"#\s*bftlint:\s*ignore\[([a-z\-,\s]+)\]")
+
+
+@dataclass
+class Finding:
+    path: str
+    line: int
+    rule: str
+    message: str
+
+    def to_json(self) -> dict:
+        return {
+            "path": self.path,
+            "line": self.line,
+            "rule": self.rule,
+            "message": self.message,
+        }
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _waived(lines: list[str], lineno: int, rule: str) -> bool:
+    """True when the finding line carries ``# bftlint: ignore[rule]``
+    (comma lists allowed), or the line above is a standalone waiver
+    comment (a trailing waiver on the previous line waives only that
+    line, not its neighbors)."""
+    for ln in (lineno, lineno - 1):
+        if not (1 <= ln <= len(lines)):
+            continue
+        text = lines[ln - 1]
+        if ln != lineno and not text.lstrip().startswith("#"):
+            continue
+        m = _WAIVER_RE.search(text)
+        if m and rule in [r.strip() for r in m.group(1).split(",")]:
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Registry extraction (AST-parsed, never imported: bftlint must run on
+# a box with nothing but the stdlib).
+# ---------------------------------------------------------------------------
+
+
+def declared_flags(root: str) -> set[str]:
+    """Flag names declared in bftkv_tpu/flags.py (``_flag("NAME", ...)``
+    calls)."""
+    path = os.path.join(root, "bftkv_tpu", "flags.py")
+    tree = ast.parse(open(path).read(), filename=path)
+    out: set[str] = set()
+    for node in ast.walk(tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "_flag"
+            and node.args
+            and isinstance(node.args[0], ast.Constant)
+            and isinstance(node.args[0].value, str)
+        ):
+            out.add(node.args[0].value)
+    return out
+
+
+def declared_label_keys(root: str) -> set[str]:
+    """The closed label-key enum from metrics.LABEL_KEYS."""
+    path = os.path.join(root, "bftkv_tpu", "metrics.py")
+    tree = ast.parse(open(path).read(), filename=path)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "LABEL_KEYS":
+                    return {
+                        e.value
+                        for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                    }
+    raise RuntimeError("metrics.LABEL_KEYS not found")
+
+
+# ---------------------------------------------------------------------------
+# Per-file analysis.
+# ---------------------------------------------------------------------------
+
+
+class _Parents(ast.NodeVisitor):
+    def __init__(self):
+        self.parents: dict[ast.AST, ast.AST] = {}
+
+    def generic_visit(self, node):
+        for child in ast.iter_child_nodes(node):
+            self.parents[child] = node
+        super().generic_visit(node)
+
+
+def _mentions_armed(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Attribute) and sub.attr == "ARMED":
+            return True
+        if isinstance(sub, ast.Name) and sub.id == "ARMED":
+            return True
+    return False
+
+
+def _armed_polarity(test: ast.AST, neg: bool = False) -> str | None:
+    """Which branch of a test mentioning ARMED is the armed one:
+    ``"true"`` (e.g. ``fp.ARMED``, ``fp.ARMED and x``) means the
+    body runs armed, ``"false"`` (e.g. ``not fp.ARMED``) means the
+    body runs DISARMED, ``None`` when ARMED is not mentioned."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _armed_polarity(test.operand, not neg)
+    if isinstance(test, ast.BoolOp):
+        for v in test.values:
+            pol = _armed_polarity(v, neg)
+            if pol is not None:
+                return pol
+        return None
+    if _mentions_armed(test):
+        return "false" if neg else "true"
+    return None
+
+
+def _dict_keys_ok(d: ast.Dict, allowed: set[str]) -> str | None:
+    """None if every key is a constant in ``allowed``; else a message."""
+    for k in d.keys:
+        if not isinstance(k, ast.Constant) or not isinstance(k.value, str):
+            return "label key is not a string literal"
+        if k.value not in allowed:
+            return (
+                f"label key {k.value!r} is not in metrics.LABEL_KEYS "
+                "(closed enum; extend it deliberately if this is a new "
+                "dimension)"
+            )
+    return None
+
+
+def _is_env_read(node: ast.Call) -> ast.expr | None:
+    """The name argument when ``node`` reads the environment
+    (os.environ.get / os.getenv), else None."""
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        # os.environ.get(...) / _os.environ.get(...)
+        if (
+            f.attr == "get"
+            and isinstance(f.value, ast.Attribute)
+            and f.value.attr == "environ"
+        ):
+            return node.args[0] if node.args else None
+        # os.getenv(...)
+        if f.attr == "getenv" and isinstance(f.value, ast.Name):
+            return node.args[0] if node.args else None
+    return None
+
+
+class _FileLinter:
+    def __init__(
+        self,
+        path: str,
+        rel: str,
+        rules: set[str],
+        flags_declared: set[str],
+        label_keys: set[str],
+    ):
+        self.path = path
+        self.rel = rel.replace(os.sep, "/")
+        self.rules = rules
+        self.flags_declared = flags_declared
+        self.label_keys = label_keys
+        self.src = open(path).read()
+        self.lines = self.src.split("\n")
+        self.tree = ast.parse(self.src, filename=path)
+        p = _Parents()
+        p.visit(self.tree)
+        self.parents = p.parents
+        self.findings: list[Finding] = []
+
+    def emit(self, node: ast.AST, rule: str, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        if _waived(self.lines, line, rule):
+            return
+        self.findings.append(Finding(self.rel, line, rule, message))
+
+    # -- rule: env-flag ----------------------------------------------------
+
+    def check_env_flag(self) -> None:
+        if self.rel.endswith("bftkv_tpu/flags.py"):
+            return
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                arg = self._env_read_of_bftkv(node)
+                if arg is not None:
+                    self.emit(
+                        node, "env-flag",
+                        f"direct environment read of {arg!r}: go through "
+                        "the bftkv_tpu.flags seam (raw/get/enabled/...) "
+                        "and declare the flag in the registry",
+                    )
+                self._check_flags_call(node)
+            elif isinstance(node, ast.Subscript):
+                # os.environ["BFTKV_..."]
+                v = node.value
+                if (
+                    isinstance(v, ast.Attribute)
+                    and v.attr == "environ"
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)
+                    and node.slice.value.startswith("BFTKV_")
+                ):
+                    self.emit(
+                        node, "env-flag",
+                        "direct environ subscript of "
+                        f"{node.slice.value!r}: go through bftkv_tpu.flags",
+                    )
+
+    def _env_read_of_bftkv(self, node: ast.Call) -> str | None:
+        arg = _is_env_read(node)
+        if (
+            arg is not None
+            and isinstance(arg, ast.Constant)
+            and isinstance(arg.value, str)
+            and arg.value.startswith("BFTKV_")
+        ):
+            return arg.value
+        return None
+
+    def _check_flags_call(self, node: ast.Call) -> None:
+        f = node.func
+        if not (
+            isinstance(f, ast.Attribute)
+            and isinstance(f.value, ast.Name)
+            and f.value.id == "flags"
+            and f.attr in ("raw", "get", "enabled", "get_int", "get_float")
+        ):
+            return
+        if not node.args:
+            return
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value not in self.flags_declared:
+                self.emit(
+                    node, "env-flag",
+                    f"flag {arg.value!r} is not declared in "
+                    "bftkv_tpu/flags.py (add it with default + doc line)",
+                )
+
+    # -- rule: label-enum --------------------------------------------------
+
+    def check_label_enum(self) -> None:
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (
+                isinstance(f, ast.Attribute)
+                and f.attr in ("incr", "observe", "gauge")
+            ):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "labels":
+                    self._check_labels_value(node, kw.value)
+
+    def _check_labels_value(self, call: ast.Call, value: ast.expr) -> None:
+        for d in self._resolve_label_dicts(call, value):
+            if d is None:
+                self.emit(
+                    call, "label-enum",
+                    "labels= is not resolvable to a dict literal (pass a "
+                    "literal, or assign one to a local immediately before "
+                    "the call) — closed-enum keys cannot be checked",
+                )
+                return
+            msg = _dict_keys_ok(d, self.label_keys)
+            if msg:
+                self.emit(call, "label-enum", msg)
+
+    def _resolve_label_dicts(self, call, value):
+        """Yield the dict literal(s) ``value`` can denote, or None when
+        unresolvable.  Handles literals, None, IfExp branches, and a
+        single-hop local name assigned from those in the enclosing
+        function."""
+        if isinstance(value, ast.Dict):
+            yield value
+            return
+        if isinstance(value, ast.Constant) and value.value is None:
+            return
+        if isinstance(value, ast.IfExp):
+            yield from self._resolve_label_dicts(call, value.body)
+            yield from self._resolve_label_dicts(call, value.orelse)
+            return
+        if isinstance(value, ast.Name):
+            fn = self._enclosing_function(call)
+            assigns = [
+                n.value
+                for n in ast.walk(fn if fn is not None else self.tree)
+                if isinstance(n, ast.Assign)
+                and any(
+                    isinstance(t, ast.Name) and t.id == value.id
+                    for t in n.targets
+                )
+            ]
+            if assigns:
+                for a in assigns:
+                    yield from self._resolve_label_dicts(call, a)
+                return
+        yield None
+
+    def _enclosing_function(self, node: ast.AST):
+        cur = self.parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+            cur = self.parents.get(cur)
+        return None
+
+    # -- rule: failpoint-guard ---------------------------------------------
+
+    def check_failpoint_guard(self) -> None:
+        if "bftkv_tpu/faults/" in self.rel:
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_fire = (
+                isinstance(f, ast.Attribute) and f.attr == "fire"
+            ) or (isinstance(f, ast.Name) and f.id == "fire")
+            if not is_fire:
+                continue
+            if self._guarded_by_armed(node):
+                continue
+            self.emit(
+                node, "failpoint-guard",
+                "failpoint fire() outside the `if ARMED:` module-bool "
+                "guard — hook sites must not pay context construction "
+                "when disarmed (PR 3 parity contract)",
+            )
+
+    def _guarded_by_armed(self, node: ast.AST) -> bool:
+        # Branch-SENSITIVE: `if fp.ARMED:` guards only its body, and
+        # an early return guards only when its test is the negated
+        # form (`if not fp.ARMED: return`).  A fire() in the else
+        # branch, or below `if fp.ARMED: return`, runs exactly when
+        # disarmed — the opposite of the contract — and must flag.
+        # (a) ancestor If / IfExp with the call on the armed branch
+        cur: ast.AST | None = node
+        while cur is not None:
+            parent = self.parents.get(cur)
+            if isinstance(parent, (ast.If, ast.IfExp)):
+                pol = _armed_polarity(parent.test)
+                in_body = (
+                    cur in parent.body
+                    if isinstance(parent, ast.If)
+                    else cur is parent.body
+                )
+                in_orelse = (
+                    cur in parent.orelse
+                    if isinstance(parent, ast.If)
+                    else cur is parent.orelse
+                )
+                if pol == "true" and in_body:
+                    return True
+                if pol == "false" and in_orelse:
+                    return True
+            if (
+                isinstance(parent, ast.BoolOp)
+                and isinstance(parent.op, ast.And)
+            ):
+                # `fp.ARMED and fp.fire(...)`: guarded when a positive
+                # ARMED mention precedes the value holding the call.
+                idx = (
+                    parent.values.index(cur)
+                    if cur in parent.values
+                    else len(parent.values)
+                )
+                if any(
+                    _armed_polarity(v) == "true"
+                    for v in parent.values[:idx]
+                ):
+                    return True
+            cur = parent
+        # (b) early-return guard at the top of the enclosing function:
+        #     if not fp.ARMED: return ...   (negated form ONLY)
+        fn = self._enclosing_function(node)
+        if fn is not None:
+            for stmt in fn.body:
+                if (
+                    isinstance(stmt, ast.If)
+                    and _armed_polarity(stmt.test) == "false"
+                    and any(isinstance(s, ast.Return) for s in stmt.body)
+                ):
+                    return True
+        return False
+
+    # -- rule: interned-error ----------------------------------------------
+
+    def check_interned_error(self) -> None:
+        on_layer = any(layer in self.rel for layer in _PROTOCOL_LAYERS)
+        for node in ast.walk(self.tree):
+            if (
+                on_layer
+                and isinstance(node, ast.Raise)
+                and isinstance(node.exc, ast.Call)
+                and isinstance(node.exc.func, ast.Name)
+                and node.exc.func.id in ("Exception", "RuntimeError")
+            ):
+                self.emit(
+                    node, "interned-error",
+                    f"raise {node.exc.func.id} on a wire-facing layer: "
+                    "protocol errors must intern via errors.new_error / "
+                    "ERR_* so both sides compare equal",
+                )
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "new_error"
+                and not self.rel.endswith("bftkv_tpu/errors.py")
+                and node.args
+                and not (
+                    isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)
+                )
+            ):
+                self.emit(
+                    node, "interned-error",
+                    "new_error() with a dynamic message outside errors.py "
+                    "grows the intern registry without bound — intern a "
+                    "constant or add a parser like wrong_shard_error",
+                )
+
+    # -- rule: swallowed-exception -----------------------------------------
+
+    def check_swallowed_exception(self) -> None:
+        on_layer = any(layer in self.rel for layer in _PROTOCOL_LAYERS)
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                self.emit(
+                    node, "swallowed-exception",
+                    "bare `except:` catches SystemExit/KeyboardInterrupt "
+                    "— name the exception class",
+                )
+                continue
+            if not on_layer:
+                continue
+            only_noop = all(
+                isinstance(s, (ast.Pass, ast.Continue)) for s in node.body
+            )
+            if not only_noop or not self._broad_catch(node.type):
+                continue
+            end = max(
+                getattr(s, "end_lineno", s.lineno) for s in node.body
+            )
+            span = self.lines[node.lineno - 1 : end]
+            if not any("#" in ln for ln in span):
+                self.emit(
+                    node, "swallowed-exception",
+                    "exception swallowed with no comment saying why that "
+                    "is safe (wire-facing layer) — explain or handle",
+                )
+
+    @staticmethod
+    def _broad_catch(t: ast.expr) -> bool:
+        """True for ``except Exception``/``BaseException`` (alone or in
+        a tuple).  Narrow catches (ERR_NOT_FOUND, OSError, ValueError)
+        with a no-op body are idiomatic not-found/cleanup control flow
+        and stay unflagged — the hazard the rule encodes is the BROAD
+        silent swallow that can eat real protocol bugs."""
+        if isinstance(t, ast.Tuple):
+            return any(_FileLinter._broad_catch(e) for e in t.elts)
+        return isinstance(t, ast.Name) and t.id in (
+            "Exception", "BaseException",
+        )
+
+    # -- rule: named-lock --------------------------------------------------
+
+    def check_named_lock(self) -> None:
+        if not self.rel.startswith("bftkv_tpu/") or self.rel.endswith(
+            "devtools/lockwatch.py"
+        ):
+            return
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("Lock", "RLock")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "threading"
+            ):
+                self.emit(
+                    node, "named-lock",
+                    f"direct threading.{node.func.attr}() — create locks "
+                    "through devtools.lockwatch.named_lock(name) so the "
+                    "lock sanitizer sees them",
+                )
+
+    def run(self) -> list[Finding]:
+        if "env-flag" in self.rules:
+            self.check_env_flag()
+        if "label-enum" in self.rules:
+            self.check_label_enum()
+        if "failpoint-guard" in self.rules:
+            self.check_failpoint_guard()
+        if "interned-error" in self.rules:
+            self.check_interned_error()
+        if "swallowed-exception" in self.rules:
+            self.check_swallowed_exception()
+        if "named-lock" in self.rules:
+            self.check_named_lock()
+        return self.findings
+
+
+# ---------------------------------------------------------------------------
+# README flags-table freshness.
+# ---------------------------------------------------------------------------
+
+
+def check_readme(root: str) -> list[Finding]:
+    """The README section between the flags-table markers must equal
+    the registry-generated one (``python -m bftkv_tpu.flags --readme``).
+
+    The registry is loaded from ``root``'s own ``flags.py`` via an
+    isolated spec-load (the module is stdlib-only by design, so it
+    executes standalone): a plain ``import bftkv_tpu.flags`` would
+    resolve through ``sys.modules``/``sys.path`` and could silently
+    validate the target tree's README against a DIFFERENT checkout's
+    registry."""
+    import importlib.util
+
+    flags_path = os.path.join(root, "bftkv_tpu", "flags.py")
+    spec = importlib.util.spec_from_file_location(
+        "_bftlint_flags_under_check", flags_path
+    )
+    _flags = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(_flags)
+    expected = _flags.readme_table()
+    readme_path = os.path.join(root, "README.md")
+    text = open(readme_path).read()
+    begin, end = _flags.README_BEGIN, _flags.README_END
+    i, j = text.find(begin), text.find(end)
+    if i < 0 or j < 0:
+        return [
+            Finding(
+                "README.md", 1, "readme-flags",
+                "flags-table markers missing: paste the output of "
+                "`python -m bftkv_tpu.flags --readme` into README.md",
+            )
+        ]
+    actual = text[i : j + len(end)]
+    if actual.strip() != expected.strip():
+        line = text[:i].count("\n") + 1
+        return [
+            Finding(
+                "README.md", line, "readme-flags",
+                "flags table is stale: regenerate with "
+                "`python -m bftkv_tpu.flags --readme` (the registry in "
+                "bftkv_tpu/flags.py is the source of truth)",
+            )
+        ]
+    return []
+
+
+# ---------------------------------------------------------------------------
+# Drivers.
+# ---------------------------------------------------------------------------
+
+
+def _walk_py(root: str, sub: str) -> list[str]:
+    out = []
+    for dirpath, dirnames, filenames in os.walk(os.path.join(root, sub)):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for fn in filenames:
+            if fn.endswith(".py"):
+                out.append(os.path.join(dirpath, fn))
+    return sorted(out)
+
+
+def _lint_file(
+    p: str, rel: str, rules: set, flags_declared: set, label_keys: set
+) -> list[Finding]:
+    """One file's findings; an unreadable or unparsable file is itself
+    a finding (``parse-error``), never a traceback — the linter must
+    survive hostile input like everything else in this tree."""
+    try:
+        return _FileLinter(p, rel, rules, flags_declared, label_keys).run()
+    except SyntaxError as e:
+        return [
+            Finding(
+                rel, e.lineno or 1, "parse-error",
+                f"file does not parse: {e.msg}",
+            )
+        ]
+    except OSError as e:
+        return [
+            Finding(rel, 1, "parse-error", f"cannot read file: {e}")
+        ]
+
+
+def lint_paths(
+    paths: list[str],
+    root: str,
+    rules: set[str] | None = None,
+) -> list[Finding]:
+    """Lint explicit files with every AST rule (fixture mode)."""
+    rules = rules or set(RULES)
+    flags_declared = declared_flags(root)
+    label_keys = declared_label_keys(root)
+    findings: list[Finding] = []
+    for p in paths:
+        rel = os.path.relpath(p, root) if os.path.isabs(p) else p
+        findings.extend(
+            _lint_file(p, rel, rules, flags_declared, label_keys)
+        )
+    return findings
+
+
+def lint_repo(root: str) -> list[Finding]:
+    """The full repo walk: bftkv_tpu/ + tools/ with layer-scoped rules,
+    plus the README freshness check."""
+    flags_declared = declared_flags(root)
+    label_keys = declared_label_keys(root)
+    findings: list[Finding] = []
+    rules = set(RULES)
+    for p in _walk_py(root, "bftkv_tpu") + _walk_py(root, "tools"):
+        rel = os.path.relpath(p, root)
+        findings.extend(
+            _lint_file(p, rel, rules, flags_declared, label_keys)
+        )
+    findings.extend(check_readme(root))
+    return findings
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.bftlint",
+        description="project invariant linter (DESIGN.md §16)",
+    )
+    ap.add_argument(
+        "paths", nargs="*",
+        help="explicit files to lint with ALL rules (default: repo "
+        "walk over bftkv_tpu/ + tools/ plus README freshness)",
+    )
+    ap.add_argument("--root", default=".", help="repo root")
+    ap.add_argument(
+        "--json", action="store_true", help="machine-readable findings"
+    )
+    ap.add_argument(
+        "--rules", default=None,
+        help="comma list restricting which rules run",
+    )
+    args = ap.parse_args(argv)
+    rules = set(args.rules.split(",")) if args.rules else None
+    if args.paths:
+        findings = lint_paths(args.paths, args.root, rules)
+    else:
+        findings = lint_repo(args.root)
+        if rules:
+            findings = [f for f in findings if f.rule in rules]
+    if args.json:
+        print(json.dumps([f.to_json() for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(
+            f"bftlint: {len(findings)} finding(s)"
+            if findings
+            else "bftlint: clean"
+        )
+    return 1 if findings else 0
