@@ -1791,6 +1791,266 @@ def bench_cluster_split(
             s.tr.stop()
 
 
+def _sidecar_tenant_main(argv: list[str]) -> None:
+    """One tenant PROCESS of the cluster_sidecar bench (spawned as
+    ``bench.py --sidecar-tenant ...``): signs/verifies batches either
+    locally (the per-process dispatcher baseline — on a CPU-calibrated
+    box that is the inline native-Montgomery host path) or through the
+    shared sidecar, and reports its own measured window so the parent
+    aggregates overlapping tenants honestly."""
+    import argparse
+    import statistics
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--addr", required=True)
+    ap.add_argument("--mode", choices=["local", "remote"], required=True)
+    ap.add_argument("--role", choices=["replica", "gateway"],
+                    default="replica")
+    ap.add_argument("--rounds", type=int, default=24)
+    ap.add_argument("--batch", type=int, default=16)
+    ap.add_argument("--bits", type=int, default=2048)
+    ap.add_argument("--interval-ms", type=float, default=0.0,
+                    help="open-loop arrival interval per batch (0 = "
+                         "closed loop); latency is measured from the "
+                         "SCHEDULED time, so backlog is charged to the "
+                         "laggard (coordinated-omission corrected)")
+    ap.add_argument("--start-at", type=float, default=0.0)
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args(argv)
+
+    from bftkv_tpu.crypto import rsa as rsamod
+    from bftkv_tpu.crypto.remote_verify import (
+        RemoteSignerDomain,
+        RemoteVerifierDomain,
+    )
+
+    # Deployment-shaped keys: replica share issuance is RSA-2048.
+    key = rsamod.generate(args.bits)
+    vitems = [
+        (b"sct-%d" % i, rsamod.sign(b"sct-%d" % i, key), key.public)
+        for i in range(args.batch)
+    ]
+    signer = RemoteSignerDomain(args.addr) if args.mode == "remote" else None
+    verifier = (
+        RemoteVerifierDomain(args.addr) if args.mode == "remote" else None
+    )
+    # Warm the connection + handle registration outside the window.
+    if signer is not None and args.role == "replica":
+        signer.sign_batch([(b"warm", key)])
+    if verifier is not None:
+        verifier.verify_batch(vitems[:1])
+    now = time.time()
+    if args.start_at > now:
+        time.sleep(args.start_at - now)  # overlap gate across tenants
+
+    interval = args.interval_ms / 1000.0
+    sign_lats: list[float] = []
+    verify_lats: list[float] = []
+    # One _OpenLoop per tenant process (coordinated-omission-corrected
+    # latency from the DUE time); interval 0 = closed loop.
+    ol = _OpenLoop(1.0 / interval, 1) if interval else None
+    t0 = ol.t0 if ol else time.perf_counter()
+    for r in range(args.rounds):
+        due = ol.wait(0, r) if ol else time.perf_counter()
+        if args.role == "replica":
+            msgs = [(b"sg-%d-%d" % (r, i), key) for i in range(args.batch)]
+            if signer is not None:
+                sigs = signer.sign_batch(msgs)
+            else:
+                sigs = [rsamod.sign(m, k) for m, k in msgs]
+            sign_lats.append(time.perf_counter() - due)
+            assert all(sigs)
+        else:
+            if verifier is not None:
+                ok = verifier.verify_batch(vitems)
+            else:
+                ok = [rsamod.verify_host(m, s, k) for m, s, k in vitems]
+            verify_lats.append(time.perf_counter() - due)
+            assert all(ok)
+    elapsed = time.perf_counter() - t0
+    ops = (len(sign_lats) + len(verify_lats)) * args.batch
+    with open(args.out, "w") as f:
+        json.dump(
+            {
+                "role": args.role,
+                "mode": args.mode,
+                "elapsed_s": elapsed,
+                "ops": ops,
+                "sign_batch_p50_s": (
+                    statistics.median(sign_lats) if sign_lats else None
+                ),
+                "verify_batch_p50_s": (
+                    statistics.median(verify_lats) if verify_lats else None
+                ),
+                "batch": args.batch,
+            },
+            f,
+        )
+
+
+def bench_cluster_sidecar(
+    replicas: int = 2,
+    gateways: int = 1,
+    rounds: int = 40,
+    batch: int = 16,
+    bits: int = 2048,
+    sign_interval_ms: float = 110.0,
+    verify_interval_ms: float = 50.0,
+) -> dict:
+    """Shared crypto sidecar vs per-process dispatchers (ROADMAP item
+    2, DESIGN.md §17): N replica-shaped tenant PROCESSES (sign bursts)
+    plus a gateway-shaped one (verify bursts) offer the SAME open-loop
+    load twice on the same box —
+
+    - **baseline**: each process on its own crypto (the per-process
+      dispatcher shape; CPU calibration makes that the inline native-
+      Montgomery host path) — concurrent bursts contend fair-share;
+    - **shared**: every process through ONE sidecar over a unix
+      socket, where cross-tenant batches coalesce in the service's
+      dispatchers (clients still self-check signatures and spot-check
+      verdicts — the untrusted-service tax is IN the measurement).
+
+    Latency is measured from each burst's SCHEDULED arrival
+    (coordinated-omission corrected, the ``--open-loop`` precedent).
+    The claims the section carries: sidecar batch occupancy > 1 item
+    per launch with ≥2 tenant processes (cross-process coalescing is
+    real), and shared sign p50 at or under the per-process baseline at
+    the same offered load — central FIFO service beats fair-share
+    interleaving for equal-size bursts (classic M/D/1-vs-PS), and on
+    an accelerator box the gap widens further by the
+    launch-amortization the kernel sections measure."""
+    import statistics
+    import subprocess
+    import tempfile
+
+    from bftkv_tpu.cmd import verify_sidecar as vs
+    from bftkv_tpu.metrics import registry as metrics
+
+    tmp = tempfile.mkdtemp(prefix="bftkv-bench-sidecar-")
+    addr = "unix:" + os.path.join(tmp, "crypto.sock")
+    t_setup = time.perf_counter()
+    srv, _t = vs.serve(addr)
+    setup_s = time.perf_counter() - t_setup
+
+    def run_phase(mode: str) -> dict:
+        outs = []
+        procs = []
+        start_at = time.time() + 8.0  # interpreter+keygen outside window
+        roles = ["replica"] * replicas + ["gateway"] * gateways
+        gw_rounds = max(
+            1, int(rounds * sign_interval_ms / verify_interval_ms)
+        )
+        for i, role in enumerate(roles):
+            out = os.path.join(tmp, f"{mode}-{i}.json")
+            outs.append(out)
+            interval = (
+                sign_interval_ms if role == "replica"
+                else verify_interval_ms
+            )
+            procs.append(
+                subprocess.Popen(
+                    [
+                        sys.executable, os.path.abspath(__file__),
+                        "--sidecar-tenant",
+                        "--addr", addr, "--mode", mode, "--role", role,
+                        "--rounds",
+                        str(rounds if role == "replica" else gw_rounds),
+                        "--batch", str(batch),
+                        "--bits", str(bits),
+                        "--interval-ms", str(interval),
+                        "--start-at", str(start_at), "--out", out,
+                    ],
+                    env=dict(os.environ, JAX_PLATFORMS="cpu"),
+                )
+            )
+        for p in procs:
+            p.wait(timeout=600)
+        docs = []
+        for out in outs:
+            with open(out) as f:
+                docs.append(json.load(f))
+        sign_p50s = [
+            d["sign_batch_p50_s"] for d in docs if d["sign_batch_p50_s"]
+        ]
+        verify_p50s = [
+            d["verify_batch_p50_s"]
+            for d in docs
+            if d["verify_batch_p50_s"]
+        ]
+        return {
+            "ops": sum(d["ops"] for d in docs),
+            "elapsed_s": max(d["elapsed_s"] for d in docs),
+            "sign_batch_p50_s": round(statistics.median(sign_p50s), 5)
+            if sign_p50s
+            else None,
+            "sign_p50_ms_per_op": round(
+                statistics.median(sign_p50s) / batch * 1000, 3
+            )
+            if sign_p50s
+            else None,
+            "verify_batch_p50_s": round(
+                statistics.median(verify_p50s), 5
+            )
+            if verify_p50s
+            else None,
+        }
+
+    try:
+        baseline = run_phase("local")
+        metrics.reset()
+        shared = run_phase("remote")
+        snap = metrics.snapshot()
+
+        def occ(name: str):
+            flushes = snap.get(f"{name}.flushes", 0)
+            return (
+                round(snap.get(f"{name}.items", 0) / flushes, 2)
+                if flushes
+                else None
+            )
+
+        shared_rate = shared["ops"] / shared["elapsed_s"]
+        sp50 = shared["sign_p50_ms_per_op"]
+        bp50 = baseline["sign_p50_ms_per_op"]
+        return {
+            "tenants": replicas + gateways,
+            "replicas": replicas,
+            "gateways": gateways,
+            "rounds": rounds,
+            "batch": batch,
+            "bits": bits,
+            "sidecar_ops_per_sec": round(shared_rate, 2),
+            "baseline_ops_per_sec": round(
+                baseline["ops"] / baseline["elapsed_s"], 2
+            ),
+            "sign_p50_ms_per_op": {
+                "per_process": bp50,
+                "shared_sidecar": sp50,
+            },
+            "sign_p50_shared_vs_baseline": round(sp50 / bp50, 3)
+            if sp50 and bp50
+            else None,
+            "verify_batch_p50_s": {
+                "per_process": baseline["verify_batch_p50_s"],
+                "shared_sidecar": shared["verify_batch_p50_s"],
+            },
+            "sign_occupancy_per_launch": occ("signdispatch"),
+            "verify_occupancy_per_launch": occ("dispatch"),
+            "coalesced": bool(
+                (occ("signdispatch") or 0) > 1
+                or (occ("dispatch") or 0) > 1
+            ),
+            "shed": srv.service.admission.shed,
+            "sign_remote": snap.get("sidecar.items{op=sign}", 0),
+            "verify_remote": snap.get("sidecar.items{op=verify}", 0),
+            "setup_s": round(setup_s, 1),
+        }
+    finally:
+        srv.service.stop()
+        srv.shutdown()
+        srv.server_close()
+
+
 def bench_threshold(rounds: int = 3) -> dict:
     """BASELINE config 3/4 signing: live (t,n)=(5,9) threshold CA over a
     9-replica cluster — RSA-2048 and ECDSA P-256 dist_sign rounds
@@ -1946,6 +2206,7 @@ SECTION_NAMES = {
     "bmix64ec": "cluster_64_batched_mix_ec",
     "cshards": "cluster_shards",
     "csplit": "cluster_split",
+    "csc": "cluster_sidecar",
     "c4gray": "cluster_4_gray",
     "cgw": "cluster_gateway",
     "thr": "threshold_5_9",
@@ -1958,7 +2219,9 @@ SECTION_NAMES = {
 # backend; cluster_4_gray is hedged-vs-unhedged on the same box, also
 # self-relative; cluster_gateway is gateway-vs-direct on the same box,
 # likewise self-relative.
-CPU_OK = {"tally", "c4", "cshards", "csplit", "c4gray", "cgw"}
+# cluster_sidecar is shared-vs-per-process on the same box, also
+# self-relative.
+CPU_OK = {"tally", "c4", "cshards", "csplit", "c4gray", "cgw", "csc"}
 
 # Per-section subprocess timeouts (seconds).  The flapping tunnel makes
 # a hung section indistinguishable from a slow one until the timeout
@@ -1973,6 +2236,7 @@ TOKEN_TIMEOUT = {
     "cgw": 900,
     "b16": 1200, "b64": 1500, "bmix64": 1500, "bmix64ec": 1500,
     "c64": 1500, "mix64": 1500, "cshards": 1500, "csplit": 900,
+    "csc": 900,
 }
 
 # Headline preference: batched 64-replica pipeline first (the TPU-native
@@ -2077,6 +2341,14 @@ def _section_spec(token: str):
             writers=2 if FAST else 4,
             writes_per_writer=3 if FAST else 5,
             open_loop=open_loop,
+        ),
+        # Shared crypto sidecar (ROADMAP item 2): tenant processes
+        # sign+verify through ONE box-wide service vs per-process
+        # crypto; cross-process batch occupancy and sign/verify p50.
+        "csc": lambda: bench_cluster_sidecar(
+            replicas=1 if FAST else 2,
+            rounds=10 if FAST else 24,
+            batch=8 if FAST else 16,
         ),
         "b16": lambda: bench_cluster_batch(
             16, 4, 2 if FAST else 4, batch_size, 1 if FAST else 2
@@ -2225,7 +2497,7 @@ def main() -> None:
 
     if FAST:
         default_configs = (
-            "rns,sign,b16,kernel,modexp,ec,c4,c16,cshards,c4gray,cgw,tally"
+            "rns,sign,b16,kernel,modexp,ec,c4,c16,cshards,c4gray,cgw,csc,tally"
         )
     else:
         # Short kernel sections FIRST: the tunnel flaps and its live
@@ -2236,7 +2508,7 @@ def main() -> None:
         # BENCH_partial.json keeps whatever landed.
         default_configs = (
             "rns,sign,kernel,ec,modexp,b16,b64,bmix64,bmix64ec,"
-            "c4,c16,c64,c4http,c4ec,cshards,c4gray,cgw,thr,tally"
+            "c4,c16,c64,c4http,c4ec,cshards,c4gray,cgw,csc,thr,tally"
         )
     configs = [t for t in _env_list("BENCH_CONFIGS", default_configs)
                if t in SECTION_NAMES]
@@ -2419,7 +2691,9 @@ def main() -> None:
         pass
     print(json.dumps(record), file=sys.stderr)
     record["extra"] = _compact_extra(extra, configs, headline_from)
-    print(json.dumps(record))
+    # Compact separators: the full 22-section matrix must stay under the
+    # driver's bounded stdout tail (test_final_stdout_line_stays_small).
+    print(json.dumps(record, separators=(",", ":")))
 
 
 def _headline_tier(sec: dict) -> int:
@@ -2512,7 +2786,9 @@ if __name__ == "__main__":
         i = sys.argv.index("--open-loop")
         os.environ["BENCH_OPEN_LOOP"] = sys.argv[i + 1]
         del sys.argv[i : i + 2]
-    if len(sys.argv) >= 5 and sys.argv[1] == "--run-section":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--sidecar-tenant":
+        _sidecar_tenant_main(sys.argv[2:])
+    elif len(sys.argv) >= 5 and sys.argv[1] == "--run-section":
         _child_main(sys.argv[2], sys.argv[4])
     else:
         main()

@@ -1,0 +1,90 @@
+"""Bounded admission queue — shared by the edge gateway and the crypto
+sidecar.
+
+One instance guards one service's expensive path: at most
+``max_inflight`` operations run concurrently, at most ``max_queue``
+more may WAIT for a slot (for up to ``max_wait`` seconds), and
+anything past that is shed instantly — counted on the instance and on
+the ``metric`` counter (labelled by ``op``) — instead of queueing
+unbounded work onto a resource that is already the bottleneck.
+
+Grew out of the gateway's admission control (DESIGN.md §14.4); the
+sidecar reuses it verbatim with ``metric="sidecar.shed"`` so both
+tiers shed with identical semantics (DESIGN.md §17.4).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from bftkv_tpu.metrics import registry as metrics
+
+__all__ = ["AdmissionQueue"]
+
+
+class AdmissionQueue:
+    """Bounded admission for a service's expensive (shared-resource)
+    work.
+
+    At most ``max_inflight`` operations run concurrently; at most
+    ``max_queue`` more may WAIT for a slot (for up to ``max_wait``
+    seconds).  Anything past that is shed instantly — ``metric``
+    (default ``gateway.shed``) — instead of queueing unbounded work.
+    Cheap paths (cache hits, control frames) never enter admission at
+    all."""
+
+    def __init__(
+        self,
+        max_inflight: int = 64,
+        max_queue: int = 128,
+        max_wait: float = 2.0,
+        metric: str = "gateway.shed",
+    ):
+        self.max_inflight = max_inflight
+        self.max_queue = max_queue
+        self.max_wait = max_wait
+        self.metric = metric
+        self._cv = threading.Condition()
+        self._inflight = 0
+        self._waiting = 0
+        #: Per-INSTANCE shed count — the process metrics registry is
+        #: shared by every gateway/sidecar in one process, so /info
+        #: must not report tier-wide totals as this instance's own.
+        self.shed = 0
+
+    def acquire(self, op: str) -> bool:
+        """True = admitted (caller MUST release); False = shed."""
+        deadline = time.monotonic() + self.max_wait
+        with self._cv:
+            if self._inflight < self.max_inflight:
+                self._inflight += 1
+                return True
+            if self._waiting >= self.max_queue:
+                self.shed += 1
+                metrics.incr(self.metric, labels={"op": op})
+                return False
+            self._waiting += 1
+            try:
+                while self._inflight >= self.max_inflight:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0 or not self._cv.wait(remaining):
+                        if self._inflight >= self.max_inflight:
+                            self.shed += 1
+                            metrics.incr(
+                                self.metric, labels={"op": op}
+                            )
+                            return False
+                self._inflight += 1
+                return True
+            finally:
+                self._waiting -= 1
+
+    def release(self) -> None:
+        with self._cv:
+            self._inflight -= 1
+            self._cv.notify()
+
+    def depth(self) -> tuple[int, int]:
+        with self._cv:
+            return self._inflight, self._waiting

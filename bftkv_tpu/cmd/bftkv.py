@@ -409,6 +409,20 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--dispatch", action="store_true",
                     help="install the TPU verify/sign dispatchers "
                          "(one replica process per accelerator)")
+    ap.add_argument("--sidecar", default="",
+                    help="host:port or unix:/path of a shared CRYPTO "
+                         "sidecar (cmd.verify_sidecar): verification AND "
+                         "RSA signing batch across every co-located "
+                         "tenant process.  Results are never trusted — "
+                         "signatures are self-checked with the public "
+                         "exponent and verdicts spot-checked locally "
+                         "(BFTKV_SIDECAR_SPOT_RATE); sign keys only "
+                         "cross a unix: socket or an HMAC channel "
+                         "(--sidecar-secret), else signing stays local")
+    ap.add_argument("--sidecar-secret", default="",
+                    help="file with a shared secret: HMAC-authenticate "
+                         "sidecar frames both ways (enables remote "
+                         "signing over TCP; always fail-closed)")
     ap.add_argument("--verify-sidecar", default="",
                     help="host:port or unix:/path of a shared verify "
                          "sidecar (cmd.verify_sidecar); co-located "
@@ -451,7 +465,47 @@ def main(argv: list[str] | None = None) -> int:
 
     server, graph, crypt, qs, tr = build_server(args)
 
-    if args.verify_sidecar:
+    if args.sidecar:
+        from bftkv_tpu.ops import dispatch
+
+        from bftkv_tpu.crypto.remote_verify import (
+            RemoteSignerDomain,
+            RemoteVerifierDomain,
+            SidecarChannel,
+        )
+
+        secret = None
+        if args.sidecar_secret:
+            from bftkv_tpu.cmd.verify_sidecar import load_secret
+
+            secret = load_secret(args.sidecar_secret)
+        # ONE channel for both domains: a dishonest verdict on either
+        # op benches the service for both.  calibrate=False on the
+        # sign dispatcher: the CPU prefer_host bypass would keep
+        # Signer.issue_many from ever reaching the remote domain (the
+        # sidecar's own dispatchers re-apply the measured crossover
+        # server-side), and the per-process window stays short — the
+        # cross-process coalescing happens in the sidecar.
+        chan = SidecarChannel(args.sidecar, secret=secret)
+        dispatch.install(
+            dispatch.VerifyDispatcher(
+                verifier=RemoteVerifierDomain(channel=chan)
+            )
+        )
+        dispatch.install_signer(
+            dispatch.SignDispatcher(
+                signer=RemoteSignerDomain(channel=chan),
+                calibrate=False,
+                max_wait=0.002,
+            )
+        )
+        if not chan.carries_keys:
+            print(
+                "bftkv: sidecar channel cannot carry sign keys "
+                "(plain TCP without --sidecar-secret); signing stays "
+                "local, verification remotes", flush=True,
+            )
+    elif args.verify_sidecar:
         from bftkv_tpu.ops import dispatch
 
         from bftkv_tpu.crypto.remote_verify import RemoteVerifierDomain

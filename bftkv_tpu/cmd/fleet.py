@@ -118,6 +118,17 @@ def render(doc: dict) -> str:
             f"hit rate {rate:.0%} · shed {g.get('shed', 0)} · "
             f"verify_fail {g.get('verify_fail', 0)}"
         )
+    for name, s in sorted((doc.get("sidecars") or {}).items()):
+        mark = "·" if s["status"] == "up" else "✗"
+        q = s.get("queue") or {}
+        batch = (s.get("batch") or {}).get("sign") or {}
+        occ = batch.get("occupancy_per_launch")
+        lines.append(
+            f"  {mark} {name} [sidecar] {s['status']} · "
+            f"queue {q.get('inflight', 0)}+{q.get('waiting', 0)} "
+            f"shed {q.get('shed', 0)}"
+            + (f" · sign occupancy {occ:g}/launch" if occ else "")
+        )
     for a in doc["anomalies"][-8:]:
         lines.append(
             f"anomaly #{a['seq']} {a['kind']} src={a['source']} "

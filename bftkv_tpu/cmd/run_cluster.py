@@ -59,6 +59,7 @@ def spawn(
     join: bool = False,
     client_home: str = "",
     verify_sidecar: str = "",
+    sidecar: str = "",
     anti_entropy: float = 0.0,
     slow_trace: float | None = None,
     rpc_timeout: float | None = None,
@@ -72,7 +73,16 @@ def spawn(
 ) -> list[subprocess.Popen]:
     """``verify_sidecar``: "auto" spawns one shared sidecar process and
     routes every daemon's verification through it (public data only —
-    signing stays per-replica); "host:port" uses an existing one."""
+    signing stays per-replica); "host:port" uses an existing one.
+
+    ``sidecar``: the full shared crypto service — "auto" spawns ONE
+    sidecar (mode-0600 unix socket under db_root) that every replica
+    AND gateway signs+verifies through, with a stats endpoint the
+    ``--fleet`` collector scrapes as a ``role=sidecar`` member (it
+    takes the port after the gateways', outside all f-budget math)."""
+    if sidecar and verify_sidecar:
+        raise ValueError("--sidecar supersedes --verify-sidecar; "
+                         "pass one")
     if fleet and not api_base:
         # Argument-only precondition: checked BEFORE any daemon spawns
         # (raising mid-spawn would orphan the just-launched fleet).
@@ -104,6 +114,26 @@ def spawn(
                 env=env,
             )
         )
+    sidecar_stats = ""
+    if sidecar == "auto" or sidecar.startswith("auto:"):
+        _, _, rest = sidecar.partition(":")
+        sidecar = rest or "unix:" + os.path.join(
+            os.path.abspath(db_root), "sidecar.sock"
+        )
+        cmd = [
+            sys.executable, "-m", "bftkv_tpu.cmd.verify_sidecar",
+            "--listen", sidecar,
+        ]
+        if api_base:
+            # Stats ride the port after the gateways' APIs so the
+            # fleet collector's sequential scrape covers the sidecar
+            # (role=sidecar — excluded from every f-budget).
+            sidecar_stats = (
+                f"{api_host}:"
+                f"{api_base + len(homes) + len(gw_homes or [])}"
+            )
+            cmd += ["--stats", sidecar_stats]
+        procs.append(subprocess.Popen(cmd, env=env))
     for i, home in enumerate(homes):
         name = os.path.basename(home)
         cmd = [
@@ -121,7 +151,9 @@ def spawn(
             cmd += ["--bind-host", bind_host]
         if join:
             cmd += ["--join"]
-        if verify_sidecar:
+        if sidecar:
+            cmd += ["--sidecar", sidecar]
+        elif verify_sidecar:
             cmd += ["--verify-sidecar", verify_sidecar]
         if anti_entropy > 0:
             cmd += ["--anti-entropy", str(anti_entropy)]
@@ -149,6 +181,8 @@ def spawn(
             cmd += ["--bind-host", bind_host]
         if rpc_timeout is not None:
             cmd += ["--rpc-timeout", str(rpc_timeout)]
+        if sidecar:
+            cmd += ["--sidecar", sidecar]
         if fleet:
             cmd += ["--fleet", f"http://127.0.0.1:{fleet}/fleet"]
         procs.append(subprocess.Popen(cmd, env=env))
@@ -162,7 +196,11 @@ def spawn(
                 [
                     sys.executable, "-m", "bftkv_tpu.cmd.fleet",
                     "--api-base", str(api_base),
-                    "--count", str(len(homes) + len(gw_homes or [])),
+                    "--count", str(
+                        len(homes)
+                        + len(gw_homes or [])
+                        + (1 if sidecar_stats else 0)
+                    ),
                     "--api-host", api_host,
                     "--listen", f"127.0.0.1:{fleet}",
                     "--interval", str(fleet_interval),
@@ -218,6 +256,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--verify-sidecar", default="",
                     help='"auto" spawns one shared verification sidecar '
                          "for the fleet; or host:port of an existing one")
+    ap.add_argument("--sidecar", default="",
+                    help='"auto" spawns ONE shared crypto sidecar (sign+'
+                         "verify+modexp, unix socket under --db-root) "
+                         "that every replica and gateway batches "
+                         "through; with --fleet its stats endpoint "
+                         "joins the scrape as a role=sidecar member.  "
+                         "Or host:port/unix:path of an existing one")
     ap.add_argument("--anti-entropy", type=float, default=0.0,
                     metavar="SECONDS",
                     help="per-daemon background state-sync interval "
@@ -299,6 +344,7 @@ def main(argv: list[str] | None = None) -> int:
                   api_base=args.api_base, api_host=args.api_host,
                   bind_host=args.bind_host, client_home=args.client_home,
                   verify_sidecar=args.verify_sidecar,
+                  sidecar=args.sidecar,
                   anti_entropy=args.anti_entropy,
                   slow_trace=args.slow_trace,
                   rpc_timeout=args.rpc_timeout,

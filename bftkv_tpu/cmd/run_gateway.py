@@ -171,12 +171,48 @@ def main(argv: list[str] | None = None) -> int:
                          "down members / degraded shards")
     ap.add_argument("--fleet-interval", type=float, default=5.0)
     ap.add_argument("--rpc-timeout", type=float, default=None)
+    ap.add_argument("--sidecar", default="",
+                    help="host:port or unix:/path of the shared crypto "
+                         "sidecar: the gateway's certified-fill verifies "
+                         "and coalesced-write signing batch across the "
+                         "whole box (results self-/spot-checked; see "
+                         "bftkv --sidecar)")
+    ap.add_argument("--sidecar-secret", default="",
+                    help="shared-secret file for HMAC sidecar frames")
     args = ap.parse_args(argv)
 
     if os.environ.get("JAX_PLATFORMS", "").strip() == "cpu":
         from bftkv_tpu.hostcpu import force_cpu
 
         force_cpu(1)
+
+    if args.sidecar:
+        from bftkv_tpu.ops import dispatch
+
+        from bftkv_tpu.crypto.remote_verify import (
+            RemoteSignerDomain,
+            RemoteVerifierDomain,
+            SidecarChannel,
+        )
+
+        secret = None
+        if args.sidecar_secret:
+            from bftkv_tpu.cmd.verify_sidecar import load_secret
+
+            secret = load_secret(args.sidecar_secret)
+        chan = SidecarChannel(args.sidecar, secret=secret)
+        dispatch.install(
+            dispatch.VerifyDispatcher(
+                verifier=RemoteVerifierDomain(channel=chan)
+            )
+        )
+        dispatch.install_signer(
+            dispatch.SignDispatcher(
+                signer=RemoteSignerDomain(channel=chan),
+                calibrate=False,
+                max_wait=0.002,
+            )
+        )
 
     from bftkv_tpu import topology
     from bftkv_tpu.gateway import Gateway

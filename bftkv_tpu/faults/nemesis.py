@@ -57,7 +57,83 @@ STEP_KINDS = (
     "collude",
     "slow_node",
     "route_flap",
+    "sidecar_crash",
 )
+
+
+class SidecarHarness:
+    """Embedded shared-crypto sidecar for chaos runs (``--sidecar``).
+
+    Boots one in-process sidecar on a mode-0600 unix socket and routes
+    the WHOLE cluster's verify+sign dispatchers through it, so every
+    traffic window crosses the service.  ``crash()`` is the kill -9
+    shape: listener gone, socket unlinked, the tenant connection
+    severed — clients must fall back to local crypto with ZERO failed
+    writes and the breaker-open counter must surface as the
+    ``sidecar_down`` anomaly.  ``restart()`` serves the same path again
+    and clears the (short) breaker so the next window re-registers
+    sign-key handles over a fresh connection."""
+
+    def __init__(self):
+        import os
+        import tempfile
+
+        from bftkv_tpu.cmd import verify_sidecar
+        from bftkv_tpu.crypto.remote_verify import (
+            RemoteSignerDomain,
+            RemoteVerifierDomain,
+            SidecarChannel,
+        )
+        from bftkv_tpu.ops import dispatch
+
+        self._os = os
+        self._verify_sidecar = verify_sidecar
+        self._dir = tempfile.mkdtemp(prefix="bftkv-sidecar-")
+        self._path = os.path.join(self._dir, "crypto.sock")
+        self.addr = "unix:" + self._path
+        self.srv, _ = verify_sidecar.serve(self.addr)
+        # Short breaker: a healed window must be able to go remote
+        # again within the next window, exercising reconnect +
+        # handle re-registration instead of one long local stretch.
+        self.channel = SidecarChannel(self.addr, breaker_seconds=1.0)
+        dispatch.install(
+            dispatch.VerifyDispatcher(
+                verifier=RemoteVerifierDomain(channel=self.channel),
+                calibrate=False,
+            )
+        )
+        dispatch.install_signer(
+            dispatch.SignDispatcher(
+                signer=RemoteSignerDomain(channel=self.channel),
+                calibrate=False,
+                max_wait=0.002,
+            )
+        )
+
+    def crash(self) -> None:
+        self.srv.service.stop()
+        self.srv.shutdown()
+        self.srv.server_close()
+        try:
+            self._os.unlink(self._path)
+        except OSError:
+            pass
+        # Sever the established tenant connection too: a threading
+        # server's live handler would otherwise keep answering.
+        self.channel.close()
+
+    def restart(self) -> None:
+        self.srv, _ = self._verify_sidecar.serve(self.addr)
+        self.channel.reset()
+
+    def stop(self) -> None:
+        from bftkv_tpu.ops import dispatch
+
+        try:
+            self.crash()
+        except Exception:
+            pass  # teardown-only: a half-crashed sidecar is fine here
+        dispatch.uninstall_all()
 
 
 class Nemesis:
@@ -67,10 +143,16 @@ class Nemesis:
         seed: int = 0,
         registry: fp.FaultRegistry | None = None,
         autopilot: bool = False,
+        sidecar_ctl: SidecarHarness | None = None,
     ):
         self.cluster = cluster
         self.seed = seed
         self.registry = registry or fp.registry
+        #: Embedded crypto sidecar under test (``--sidecar``): enables
+        #: the sidecar_crash step kind and its zero-failed-writes
+        #: oracle.
+        self.sidecar_ctl = sidecar_ctl
+        self.sidecar_blocked: list[dict] = []
         #: Topology autopilot under test: built in :meth:`run` (it
         #: wants the collector), drives ONE forced migration while the
         #: second half of the fault schedule lands — reconfiguration
@@ -175,7 +257,13 @@ class Nemesis:
             kind = kinds[rng.randrange(len(kinds))]
             if kind == "route_flap" and not flap_ok:
                 kind = "partition"
-            if kind == "route_flap":
+            if kind == "sidecar_crash" and self.sidecar_ctl is None:
+                # No embedded sidecar armed: degrade like route_flap
+                # so one seeded plan stays runnable everywhere.
+                kind = "partition"
+            if kind == "sidecar_crash":
+                pool = ["sidecar01"]
+            elif kind == "route_flap":
                 # The held-back principal is a CLIENT: its writes keep
                 # routing on epoch N, land on the old owner, and must
                 # re-route off the hinted decline — the fault class the
@@ -542,6 +630,15 @@ class Nemesis:
                 # metrics feed on loopback clusters, so kind alone is
                 # the match).
                 return any(a["kind"] == "epoch_skew" for a in fresh)
+            if kind == "sidecar_crash":
+                # The crypto service died: tenants must notice — the
+                # breaker-open counter delta maps to sidecar_down in
+                # the feed (sidecar_dishonest would also count: either
+                # way the plane flagged the service).
+                return any(
+                    a["kind"] in ("sidecar_down", "sidecar_dishonest")
+                    for a in fresh
+                )
             if kind == "crash_restart":
                 # The plane "sees" an outage either as a fresh
                 # member_down transition or as the member simply BEING
@@ -688,6 +785,25 @@ class Nemesis:
                         "failed_writes": self.failures["write"] - w0,
                     }
                 )
+        elif kind == "sidecar_crash":
+            w0 = self.failures["write"]
+            self.sidecar_ctl.crash()
+            try:
+                self.traffic(tag)
+                self._observe_window(step, seq0)
+                if dwell:
+                    time.sleep(dwell)
+            finally:
+                self.sidecar_ctl.restart()
+            if self.failures["write"] > w0:
+                # The sidecar is an OPTIMIZER: its death may slow
+                # writes (local crypto), never fail them.
+                self.sidecar_blocked.append(
+                    {
+                        "step": step["step"],
+                        "failed_writes": self.failures["write"] - w0,
+                    }
+                )
         elif kind == "stale_replay":
             rules = byzantine.make_stale_replayer(self.registry, target)
             try:
@@ -783,6 +899,7 @@ class Nemesis:
         self.registry.arm(self.seed)
         self.detection = []  # a re-run must not inherit stale verdicts
         self.gray_blocked = []
+        self.sidecar_blocked = []
         self._migration = None
         self.collector = self._make_collector() if detect else None
         self.autopilot = None
@@ -901,6 +1018,7 @@ class Nemesis:
             "detection": self.detection,
             "undetected": [d for d in self.detection if not d["detected"]],
             "gray_blocked": self.gray_blocked,
+            "sidecar_blocked": self.sidecar_blocked,
             "anomalies": (
                 len(self.collector.anomalies())
                 if self.collector is not None
@@ -954,6 +1072,14 @@ def main(argv: list[str] | None = None) -> int:
                          "chaos), crash-restarted replicas are "
                          "re-delivered the current route table, and "
                          "the route_flap kind becomes available")
+    ap.add_argument("--sidecar", action="store_true",
+                    help="route the whole cluster's verify+sign through "
+                         "an embedded shared crypto sidecar and add the "
+                         "sidecar_crash kind to the fault pool: a dead "
+                         "sidecar must cost zero failed writes (local "
+                         "fallback), surface as the sidecar_down "
+                         "anomaly, and reconnect must re-register "
+                         "sign-key handles")
     args = ap.parse_args(argv)
 
     kinds = tuple(
@@ -965,20 +1091,29 @@ def main(argv: list[str] | None = None) -> int:
         args.autopilot and args.shards > 1
     ):
         ap.error("--kinds route_flap needs --autopilot and --shards 2+")
+    if kinds and "sidecar_crash" in kinds and not args.sidecar:
+        ap.error("--kinds sidecar_crash needs --sidecar")
 
+    # The sidecar's dispatchers are process-global, so it arms BEFORE
+    # the cluster boots: every server's share issuance and collective
+    # verify then routes through the service under test.
+    sidecar_ctl = SidecarHarness() if args.sidecar else None
     cluster = build_cluster(
         args.servers, 1, args.rw, bits=args.bits, n_shards=args.shards,
         n_gateways=args.gateways,
     )
     try:
         report = Nemesis(
-            cluster, seed=args.seed, autopilot=args.autopilot
+            cluster, seed=args.seed, autopilot=args.autopilot,
+            sidecar_ctl=sidecar_ctl,
         ).run(
             steps=args.steps, dwell=args.dwell,
             detect=not args.no_detect, kinds=kinds,
         )
     finally:
         cluster.stop()
+        if sidecar_ctl is not None:
+            sidecar_ctl.stop()
     # Lock-order chaos soak (DESIGN.md §16): with BFTKV_LOCKWATCH=1 the
     # whole schedule ran under the runtime lock sanitizer — any cycle in
     # the acquisition-order graph or blocking call under a watched lock
@@ -996,6 +1131,7 @@ def main(argv: list[str] | None = None) -> int:
         or not report["converged"]
         or report["undetected"]
         or report["gray_blocked"]
+        or report["sidecar_blocked"]
         or lockwatch_msg
     )
     if args.json:
@@ -1037,6 +1173,12 @@ def main(argv: list[str] | None = None) -> int:
             f"{g['target']} failed {g['failed_writes']} write(s) — a "
             "single gray member must never block commit"
         )
+    for s in report["sidecar_blocked"]:
+        print(
+            f"SIDECAR BLOCKED: step {s['step']} sidecar_crash failed "
+            f"{s['failed_writes']} write(s) — a dead crypto sidecar "
+            "must degrade to local crypto, never block a write"
+        )
     if report["violations"]:
         print("nemesis: SAFETY VIOLATIONS FOUND")
         return 1
@@ -1048,6 +1190,9 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     if report["gray_blocked"]:
         print("nemesis: GRAY MEMBER BLOCKED COMMITS")
+        return 1
+    if report["sidecar_blocked"]:
+        print("nemesis: SIDECAR DEATH BLOCKED WRITES")
         return 1
     if lockwatch_msg:
         print(lockwatch_msg)

@@ -183,6 +183,32 @@ _flag("BFTKV_EC_VERIFY_THRESHOLD", None, "int",
       "EC verify host/device crossover batch size (unset: built-in "
       "crossover constant).")
 
+_begin("Shared crypto sidecar")
+_flag("BFTKV_SIDECAR_SIGN", "on", "switch",
+      "Clients remote their RSA signing to the shared sidecar when the "
+      "channel can carry keys (unix socket or HMAC secret); `off` keeps "
+      "signing in-process (verification still remotes).")
+_flag("BFTKV_SIDECAR_SPOT_RATE", "0.05", "float",
+      "Fraction of remote verify batches whose verdicts are re-checked "
+      "locally on one sampled item; a mismatch opens the sidecar "
+      "breaker and raises the sidecar_dishonest anomaly (DESIGN.md "
+      "§17.3).")
+_flag("BFTKV_SIDECAR_BREAKER", "30", "float",
+      "Seconds the sidecar breaker skips the service after a transport "
+      "failure or a dishonest result before retrying.")
+_flag("BFTKV_SIDECAR_MAX_INFLIGHT", "4", "int",
+      "Sidecar admission: crypto batches served concurrently; more "
+      "wait, then shed (sidecar.shed).")
+_flag("BFTKV_SIDECAR_MAX_QUEUE", "64", "int",
+      "Sidecar admission: batches allowed to WAIT for a service slot "
+      "before instant shedding.")
+_flag("BFTKV_SIDECAR_MAX_WAIT", "0.5", "float",
+      "Sidecar admission: longest a batch may wait for a service slot "
+      "before it is shed.")
+_flag("BFTKV_SIDECAR_MAX_KEYS", "64", "int",
+      "Sign-key handles one sidecar connection may register (bounds "
+      "hostile registration floods).")
+
 _begin("Device kernels & dispatch")
 _flag("BFTKV_DISPATCH_CALIBRATE", "1", "switch",
       "Install-time host-vs-device crossover calibration (`0` "

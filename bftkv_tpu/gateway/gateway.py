@@ -39,6 +39,10 @@ from bftkv_tpu.errors import (
     ERR_UNCERTIFIED_RECORD,
     ERR_UNKNOWN_COMMAND,
 )
+# AdmissionQueue lives in bftkv_tpu/admission.py so the crypto sidecar
+# shares the exact shed semantics (DESIGN.md §17.4); re-exported here
+# for existing importers.
+from bftkv_tpu.admission import AdmissionQueue
 from bftkv_tpu.gateway.cache import CertifiedCache
 from bftkv_tpu.gateway.coalesce import WriteCoalescer
 from bftkv_tpu.metrics import registry as metrics
@@ -49,70 +53,6 @@ from bftkv_tpu.devtools.lockwatch import named_lock
 __all__ = ["AdmissionQueue", "Gateway"]
 
 log = logging.getLogger("bftkv_tpu.gateway")
-
-
-class AdmissionQueue:
-    """Bounded admission for upstream (quorum-touching) work.
-
-    At most ``max_inflight`` operations run upstream concurrently; at
-    most ``max_queue`` more may WAIT for a slot (for up to
-    ``max_wait`` seconds).  Anything past that is shed instantly —
-    ``gateway.shed`` — instead of queueing unbounded work onto
-    quorums that are already the bottleneck.  Cache hits never enter
-    admission at all (they touch no quorum)."""
-
-    def __init__(
-        self,
-        max_inflight: int = 64,
-        max_queue: int = 128,
-        max_wait: float = 2.0,
-    ):
-        self.max_inflight = max_inflight
-        self.max_queue = max_queue
-        self.max_wait = max_wait
-        self._cv = threading.Condition()
-        self._inflight = 0
-        self._waiting = 0
-        #: Per-INSTANCE shed count — the process metrics registry is
-        #: shared by every gateway in one process, so /info must not
-        #: report tier-wide totals as this gateway's own.
-        self.shed = 0
-
-    def acquire(self, op: str) -> bool:
-        """True = admitted (caller MUST release); False = shed."""
-        deadline = time.monotonic() + self.max_wait
-        with self._cv:
-            if self._inflight < self.max_inflight:
-                self._inflight += 1
-                return True
-            if self._waiting >= self.max_queue:
-                self.shed += 1
-                metrics.incr("gateway.shed", labels={"op": op})
-                return False
-            self._waiting += 1
-            try:
-                while self._inflight >= self.max_inflight:
-                    remaining = deadline - time.monotonic()
-                    if remaining <= 0 or not self._cv.wait(remaining):
-                        if self._inflight >= self.max_inflight:
-                            self.shed += 1
-                            metrics.incr(
-                                "gateway.shed", labels={"op": op}
-                            )
-                            return False
-                self._inflight += 1
-                return True
-            finally:
-                self._waiting -= 1
-
-    def release(self) -> None:
-        with self._cv:
-            self._inflight -= 1
-            self._cv.notify()
-
-    def depth(self) -> tuple[int, int]:
-        with self._cv:
-            return self._inflight, self._waiting
 
 
 class Gateway:

@@ -53,7 +53,7 @@ LABEL_KEYS = (
     "side",       # client / server
     "cmd",        # protocol command name (closed command enum)
     "shard",      # shard index (int, < shard count)
-    "op",         # gateway operation: read / write
+    "op",         # gateway op (read/write) / sidecar op (verify/sign/modexp)
     "point",      # failpoint name (closed hook-site enum)
     "action",     # failpoint action kind
     "endpoint",   # daemon API endpoint (closed set + "other")

@@ -80,6 +80,17 @@ ANOMALY_COUNTERS = {
     # benign in small bursts around a flip, sustained means a member
     # never received the new table).
     "server.epoch_stale": "epoch_skew",
+    # Shared crypto sidecar (DESIGN.md §17).  The breaker opened: the
+    # service is unreachable/broken and tenants are on local crypto —
+    # capacity, not safety (results were never trusted).
+    "verify.remote_breaker_open": "sidecar_down",
+    # A spot-check or signature self-check caught the sidecar lying
+    # (wrong verdict / forged signature): the Byzantine-service
+    # signal.  The tenant already fell back to local crypto.
+    "crypto.sidecar.dishonest": "sidecar_dishonest",
+    # Sustained sidecar shedding: the shared crypto plane is turning
+    # batches away — tenants absorb them locally, at host speed.
+    "sidecar.shed": "sidecar_shed",
 }
 
 
@@ -311,13 +322,14 @@ class FleetCollector:
         t0 = time.perf_counter()
         info = None
         try:
-            # Gateways self-report their cache/shed stats on /info, so
-            # their seat document is live data, not topology — refetch
-            # every scrape instead of on the 30-scrape cadence.
+            # Gateways and sidecars self-report their live stats on
+            # /info (cache/shed; queue/occupancy), so their seat
+            # document is live data, not topology — refetch every
+            # scrape instead of on the 30-scrape cadence.
             if (
                 m.info_stale
                 or not m.info
-                or m.info.get("role") == "gateway"
+                or m.info.get("role") in ("gateway", "sidecar")
             ):
                 info = m.source.info() or {}
             if not getattr(m.source, "PROBE_BY_SCRAPE", False):
@@ -469,13 +481,14 @@ class FleetCollector:
         seat is UNKNOWN, and binning it anywhere would let the shard
         it really belongs to report a full f-budget while one of its
         clique members is dark (health() surfaces these as
-        ``fleet.unseated`` instead).  Gateways (``role: gateway``) are
-        deliberately NOT shard members: an edge box holds no quorum
-        seat, so it must never enter the clique f-budget math — they
-        report under ``health()["gateways"]`` instead."""
+        ``fleet.unseated`` instead).  Gateways (``role: gateway``) and
+        the crypto sidecar (``role: sidecar``) are deliberately NOT
+        shard members: neither holds a quorum seat, so they must never
+        enter the clique f-budget math — they report under
+        ``health()["gateways"]`` / ``health()["sidecars"]`` instead."""
         shards: dict = {}
         for name, m in members.items():
-            if not m.info or m.info.get("role") == "gateway":
+            if not m.info or m.info.get("role") in ("gateway", "sidecar"):
                 continue
             sh = m.info.get("shard")
             sh = 0 if sh is None else sh
@@ -496,6 +509,25 @@ class FleetCollector:
                 if m.last_ok
                 else None,
                 **(m.info.get("gateway") or {}),
+            }
+        return out
+
+    def _sidecars(self, members: dict, now: float) -> dict:
+        """The shared crypto service's health rows: status + the
+        sidecar's own queue/occupancy/shed stats as self-reported on
+        /info — a ``role=sidecar`` member is an optimizer, never a
+        quorum seat, so it lives here instead of any f-budget."""
+        out: dict = {}
+        for name, m in members.items():
+            if not m.info or m.info.get("role") != "sidecar":
+                continue
+            out[name] = {
+                "status": m.status,
+                "scrape_s": round(m.scrape_s, 4),
+                "last_ok_age_s": round(now - m.last_ok, 1)
+                if m.last_ok
+                else None,
+                **(m.info.get("sidecar") or {}),
             }
         return out
 
@@ -614,6 +646,7 @@ class FleetCollector:
             "autopilot": autopilot,
             "shards": shards_doc,
             "gateways": self._gateways(all_members, now),
+            "sidecars": self._sidecars(all_members, now),
             "traces": {
                 **self.stitcher.summary(),
                 "recent": self.stitcher.traces(limit=10),
@@ -661,6 +694,17 @@ class FleetCollector:
                     if isinstance(g.get(field), (int, float)):
                         add(f"gateway_{field}", "gauge", lab,
                             str(g[field]))
+        scs = doc.get("sidecars") or {}
+        if scs:
+            add("sidecars_up", "gauge", "",
+                str(sum(1 for s in scs.values() if s["status"] == "up")))
+            for name, s in sorted(scs.items()):
+                lab = f'{{sidecar="{name}"}}'
+                q = s.get("queue") or {}
+                for field in ("inflight", "waiting", "shed"):
+                    if isinstance(q.get(field), (int, float)):
+                        add(f"sidecar_{field}", "gauge", lab,
+                            str(q[field]))
         add("traces_stitched", "gauge", "",
             str(doc["traces"]["stitched"]))
         add("anomalies_total", "counter", "", str(self._anomaly_seq))

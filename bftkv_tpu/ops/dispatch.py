@@ -50,6 +50,7 @@ from bftkv_tpu.devtools.lockwatch import named_lock
 __all__ = [
     "VerifyDispatcher",
     "SignDispatcher",
+    "ModexpDispatcher",
     "install",
     "uninstall",
     "get",
@@ -345,9 +346,25 @@ class _BatchDispatcher:
                     and (remaining := deadline - time.monotonic()) > 0
                 ):
                     self._cv.wait(timeout=remaining)
-                batch = self._queue
-                self._queue = []
-                self._queued_items = 0
+                # Bounded pop: whole pending entries up to ``max_batch``
+                # items (always at least one).  Draining the queue
+                # unboundedly would merge every queued caller's batch
+                # into one flush and make EACH wait for ALL — the
+                # head-of-line latency no chunking inside the flush can
+                # undo (results scatter only when the whole flush
+                # returns).  The remainder flushes on the next loop
+                # iteration, so a burst still coalesces into
+                # max_batch-sized launches.
+                batch = []
+                taken = 0
+                while self._queue and (
+                    not batch
+                    or taken + len(self._queue[0].items) <= self.max_batch
+                ):
+                    p = self._queue.pop(0)
+                    batch.append(p)
+                    taken += len(p.items)
+                self._queued_items -= taken
             if self.pipeline == 1:
                 self._flush(batch)
             else:
@@ -575,6 +592,113 @@ class SignDispatcher(_BatchDispatcher):
 
     def sign(self, message: bytes, key) -> bytes:
         return self.submit([(message, key)])[0]
+
+
+class ModexpDispatcher(_BatchDispatcher):
+    """Batched raw modular exponentiation (items: (base, exp, mod) ints).
+
+    The sidecar's third op class: tenants outsource arbitrary modexps
+    (threshold-share combination, protocol experiments) and spot-check
+    the answers themselves — the service is untrusted by construction,
+    so correctness never depends on it (DESIGN.md §17.3).  Odd moduli
+    go through the Montgomery native kernel (GIL-releasing host tier);
+    everything else falls back to ``pow``.  Batches at or above
+    ``device_threshold`` attempt one RNS device launch per width group
+    first — on an accelerator that is the shard_map fan-out path the
+    sign dispatcher already uses.
+    """
+
+    name = "modexpdispatch"
+
+    def __init__(
+        self,
+        *,
+        max_batch: int = 1024,
+        max_wait: float = 0.002,
+        pipeline: int | None = None,
+        calibrate: bool | None = None,
+        device_threshold: int | None = None,
+    ):
+        super().__init__(
+            max_batch=max_batch,
+            max_wait=max_wait,
+            pipeline=pipeline,
+            calibrate=calibrate,
+        )
+        # Same crossover semantics as the signer: below it, one native
+        # host modexp per item beats any launch.  ALWAYS_HOST on CPU
+        # backends (set by the sidecar from calibration()).
+        self.device_threshold = (
+            device_threshold
+            if device_threshold is not None
+            else ALWAYS_HOST
+        )
+
+    def _run_batch(self, items: list) -> list[int]:
+        out: list[int | None] = [None] * len(items)
+        device_idx: list[int] = []
+        if len(items) >= self.device_threshold:
+            device_idx = [
+                i
+                for i, (b, e, m) in enumerate(items)
+                if m > 2 and m % 2 == 1 and e >= 0 and 0 <= b
+            ]
+        if device_idx:
+            from bftkv_tpu.ops import limb as limb_ops
+            from bftkv_tpu.ops import rns as rns_ops
+
+            # One launch per limb-width group (uniform kernel shapes).
+            by_width: dict[int, list[int]] = {}
+            for i in device_idx:
+                w = limb_ops.nlimbs_for_bits(items[i][2].bit_length())
+                by_width.setdefault(w, []).append(i)
+            for w, idxs in by_width.items():
+                try:
+                    vals = rns_ops.power_mod_rns(
+                        [items[i][0] for i in idxs],
+                        [items[i][1] for i in idxs],
+                        [items[i][2] for i in idxs],
+                        n_bits=w * 16,
+                    )
+                except Exception:
+                    vals = None  # incapable/hostile moduli: host below
+                if vals is not None:
+                    metrics.incr("modexp.device", len(idxs))
+                    for i, v in zip(idxs, vals):
+                        out[i] = int(v)
+        from bftkv_tpu.crypto import rsa as rsamod
+
+        host = 0
+        for i, (b, e, m) in enumerate(items):
+            if out[i] is not None:
+                continue
+            host += 1
+            if m <= 0:
+                raise ValueError("modexp: modulus must be positive")
+            if (
+                rsamod._MM is not None
+                and m % 2 == 1
+                and m > 2
+                and e >= 0
+                and 0 <= b
+            ):
+                out[i] = rsamod._native_powmod(
+                    b % m, e, rsamod._mont_params(m)
+                )
+            else:
+                out[i] = pow(b, e, m)
+        if host:
+            metrics.incr("modexp.host", host)
+        return out  # type: ignore[return-value]
+
+    def _combine(self, chunks: list):
+        return [v for chunk in chunks for v in chunk]
+
+    def _empty(self):
+        return []
+
+    def powmod(self, base: int, exp: int, mod: int) -> int:
+        return self.submit([(base, exp, mod)])[0]
 
 
 _global: VerifyDispatcher | None = None

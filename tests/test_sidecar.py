@@ -203,17 +203,37 @@ def test_port_squatter_verdicts_rejected():
     lsock.listen(1)
 
     def impostor():
-        conn, _ = lsock.accept()
-        hdr = conn.recv(4)
-        (ln,) = struct.unpack(">I", hdr)
-        got = b""
-        while len(got) < ln:
-            got += conn.recv(ln - len(got))
-        (count,) = struct.unpack(">I", got[:4])
-        # forged "all valid" with a garbage tag of the right length
-        out = b"\x01" * count + b"\x00" * verify_sidecar.TAG_LEN
-        conn.sendall(struct.pack(">I", len(out)) + out)
-        conn.close()
+        # Serve every reconnect attempt: the client retries once on a
+        # fresh socket, and only the MAC check may reject the forgery —
+        # a one-shot impostor would leave the retry stalling on the
+        # listen backlog and the test would pass via timeout instead.
+        while True:
+            try:
+                conn, _ = lsock.accept()
+            except OSError:
+                return
+            try:
+                hdr = conn.recv(4)
+                if len(hdr) < 4:
+                    continue
+                (ln,) = struct.unpack(">I", hdr)
+                got = b""
+                while len(got) < ln:
+                    part = conn.recv(ln - len(got))
+                    if not part:
+                        break
+                    got += part
+                # forged v2 "all valid" reply — ST_OK + one verdict
+                # byte per item — with a garbage tag of the right
+                # length; only the response MAC can reject this shape
+                out = (
+                    bytes([verify_sidecar.ST_OK])
+                    + b"\x01" * 3
+                    + b"\x00" * verify_sidecar.TAG_LEN
+                )
+                conn.sendall(struct.pack(">I", len(out)) + out)
+            finally:
+                conn.close()
 
     t = th.Thread(target=impostor, daemon=True)
     t.start()

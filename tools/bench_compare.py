@@ -50,8 +50,10 @@ __all__ = [
 #: for its first landing (the cluster_4_gray precedent: no prior round
 #: to diff against, and its headline is a post-migration rate whose
 #: pre/post ratio is the real deliverable) — promote it to gated in a
-#: later round once a committed BENCH_r* carries it.
-REPORT_ONLY: set = {"cluster_split"}
+#: later round once a committed BENCH_r* carries it.  cluster_sidecar
+#: likewise first lands in BENCH_r09 (its deliverables are the
+#: self-relative occupancy>1 and shared-vs-baseline sign-p50 claims).
+REPORT_ONLY: set = {"cluster_split", "cluster_sidecar"}
 
 #: Absolute bound on the NEW record's hedged gray slowdown (write p50
 #: with one delayed clique member ÷ fault-free floor) — the DESIGN.md
