@@ -540,6 +540,39 @@ def _round_breakdown(since_cursor: int) -> dict:
     return out
 
 
+def _phase_budget(since_cursor: int) -> dict:
+    """Critical-path attribution over the section's own traces
+    (bftkv_tpu/obs/critpath.py): every ``client.write``/``client.read``
+    root recorded after ``since_cursor`` is decomposed into exclusive
+    per-phase seconds, and the section reports each phase's SHARE of
+    total root wall clock — the numbers that enter the committed
+    trajectory as the compact sections' 5th element, so "where did this
+    round's latency go" is answerable from BENCH_r*.json alone."""
+    from bftkv_tpu import trace as trmod
+    from bftkv_tpu.obs.critpath import attribute
+
+    spans = trmod.tracer.export(since_cursor)["spans"]
+    traces: dict[str, list] = {}
+    for s in spans:
+        traces.setdefault(s["trace"], []).append(s)
+    sums: dict[str, float] = {}
+    total = 0.0
+    for tspans in traces.values():
+        bd = attribute(tspans)
+        if bd is None or bd["op"] != "write":
+            continue
+        total += bd["root_s"]
+        for phase, secs in bd["phases"].items():
+            sums[phase] = sums.get(phase, 0.0) + secs
+    if total <= 0:
+        return {}
+    return {
+        phase: round(secs / total, 4)
+        for phase, secs in sorted(sums.items(), key=lambda kv: -kv[1])
+        if secs / total >= 0.0005
+    }
+
+
 def _make_cluster(
     n_servers: int, n_rw: int, n_users: int, storage_factory,
     transport: str = "loop", alg: str = "rsa",
@@ -847,6 +880,7 @@ def bench_cluster(
                 total_writes,
             )
         res["round_p50_s"] = _round_breakdown(trace_cur0)
+        res["phase_budget"] = _phase_budget(trace_cur0)
         res.update(_hot_loop_metrics(snap))
         return res
     finally:
@@ -1527,6 +1561,7 @@ def bench_cluster_shards(
                 "quorum_cache_hits": snap.get("quorum.cache.hits", 0),
                 "quorum_cache_misses": snap.get("quorum.cache.misses", 0),
                 "round_p50_s": _round_breakdown(trace_cur0),
+                "phase_budget": _phase_budget(trace_cur0),
                 "setup_s": round(setup_s, 1),
             }
             entry.update(
@@ -2746,14 +2781,21 @@ def _compact_extra(extra: dict, configs: list, headline_from) -> dict:
         # too (tools/bench_compare.py; two-element records stay valid).
         # The gray section carries its hedged slowdown ratio as a
         # FOURTH element — bench_compare holds it under the absolute
-        # ≤2x acceptance bound.
+        # ≤2x acceptance bound.  A section with a phase budget carries
+        # it FIFTH (gray slot null-padded), so the attribution numbers
+        # enter the committed trajectory (DESIGN.md §18).
         p50 = sec.get("write_p50_s")
         gray = sec.get("gray_slowdown_hedged")
+        pb = sec.get("phase_budget")
         if num is not None and isinstance(p50, (int, float)) and p50 > 0:
+            compact = [status, num, p50]
             if isinstance(gray, (int, float)) and gray > 0:
-                sections[name] = [status, num, p50, gray]
-            else:
-                sections[name] = [status, num, p50]
+                compact.append(gray)
+            if isinstance(pb, dict) and pb:
+                while len(compact) < 4:
+                    compact.append(None)
+                compact.append(pb)
+            sections[name] = compact
         elif num is not None:
             sections[name] = [status, num]
         else:

@@ -122,7 +122,7 @@ class _ApiHandler(BaseHTTPRequestHandler):
     #: so hostile URLs cannot blow up label cardinality.
     _ENDPOINTS = frozenset(
         ("read", "write", "writeonce", "joining", "leaving", "show",
-         "visual", "debug", "metrics", "trace", "info")
+         "visual", "debug", "metrics", "trace", "info", "profile")
     )
 
     def _handle(self):
@@ -275,6 +275,23 @@ class _ApiHandler(BaseHTTPRequestHandler):
                     default=str,
                 ).encode()
                 self._reply(200, body, "application/json")
+            elif path == "/profile" or path.startswith("/profile?"):
+                # Wall-clock sampling profile (collapsed flamegraph
+                # stacks, obs/profiler.py): the window snapshots the
+                # continuous sampler when BFTKV_PROFILE is armed, or
+                # runs a temporary one — either way bounded, text/plain,
+                # pipe straight into flamegraph.pl / speedscope.
+                from bftkv_tpu.obs import profiler
+
+                q = urllib.parse.parse_qs(urllib.parse.urlparse(path).query)
+                try:
+                    seconds = float(q.get("seconds", ["2"])[0])
+                except ValueError:
+                    seconds = 2.0
+                if not (seconds >= 0.05):  # also catches NaN
+                    seconds = 0.05
+                body = profiler.profile_for(min(seconds, 30.0)).encode()
+                self._reply(200, body, "text/plain; charset=utf-8")
             elif path == "/info":
                 body = json.dumps(
                     self.server.svc.info(), sort_keys=True
@@ -532,6 +549,17 @@ def main(argv: list[str] | None = None) -> int:
 
         dispatch.install()
         dispatch.install_signer()
+
+    from bftkv_tpu.obs import profiler
+
+    if profiler.enabled():
+        # Continuous sampler (BFTKV_PROFILE=1): /profile windows then
+        # snapshot an always-running comb instead of arming on demand.
+        profiler.ensure_started()
+        print(
+            f"bftkv: profiler armed @ {profiler.ensure_started().hz:g} Hz "
+            "(/profile?seconds=N)", flush=True,
+        )
 
     server.start(bind_host=args.bind_host)
     where = (

@@ -29,6 +29,51 @@ from bftkv_tpu.obs import FleetCollector, HTTPSource
 __all__ = ["main", "render"]
 
 
+def render_budget(doc: dict) -> str:
+    """The ``--budget`` table: per (op, shard), each phase's exclusive
+    share of the wall clock plus the p99 exemplar's breakdown — the
+    "where did the p99 go" answer (DESIGN.md §18)."""
+    lines: list[str] = []
+    for op in ("write", "read"):
+        budget = doc.get(f"{op}_budget_by_phase") or {}
+        for sh, b in sorted(budget.items(), key=lambda kv: str(kv[0])):
+            lines.append(
+                f"{op} budget · shard {sh}: {b['count']} traces, "
+                f"total {b['root_sum_s']:g}s, "
+                f"root p99≤{b['root_p99_le_s']:g}s"
+            )
+            phases = sorted(
+                b.get("phases", {}).items(),
+                key=lambda kv: -kv[1]["sum_s"],
+            )
+            for phase, pd in phases:
+                if pd["sum_s"] <= 0:
+                    continue
+                bar = "#" * max(int(pd["share"] * 40), 1)
+                lines.append(
+                    f"  {phase:<9} {pd['share']:>6.1%}  "
+                    f"{pd['sum_s']:>10.4f}s  {bar}"
+                )
+            ex = b.get("p99_exemplar")
+            if ex:
+                parts = ", ".join(
+                    f"{p}={v:g}s"
+                    for p, v in sorted(
+                        ex["phases"].items(), key=lambda kv: -kv[1]
+                    )
+                )
+                lines.append(
+                    f"  p99 exemplar: trace={ex['trace_id']} "
+                    f"{ex['root_s']:g}s → {parts}"
+                )
+    if not lines:
+        lines.append(
+            "no attributed traces yet (budgets need two scrapes: "
+            "roots attribute one scrape after they appear)"
+        )
+    return "\n".join(lines)
+
+
 def render(doc: dict) -> str:
     """The one-shot human report for one health document."""
     fl = doc["fleet"]
@@ -72,6 +117,13 @@ def render(doc: dict) -> str:
         )
         if ap.get("retired"):
             lines.append(f"  retired cliques: {ap['retired']}")
+    drops = fl.get("trace_drops") or {}
+    if drops.get("ring") or drops.get("slow"):
+        lines.append(
+            f"TRACE DROPS: ring={drops.get('ring', 0)} "
+            f"slow={drops.get('slow', 0)} — attribution under-samples; "
+            "scrape more often or raise the rings"
+        )
     for sh, sd in sorted(doc["shards"].items()):
         fb = sd["f_budget"]
         slo = sd.get("slo", {})
@@ -190,6 +242,24 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--scrapes", type=int, default=1,
                     help="one-shot: scrape this many times (interval apart) "
                          "before reporting — 2+ arms counter-delta anomalies")
+    ap.add_argument("--budget", action="store_true",
+                    help="one-shot: per-shard critical-path budget table "
+                         "(phase shares + p99 exemplar; implies 2 scrapes "
+                         "— attribution defers one scrape for stitching)")
+    ap.add_argument("--bundle", default=None, metavar="DIR", nargs="?",
+                    const="",
+                    help="one-shot: write a flight-recorder bundle of "
+                         "everything just scraped into DIR (default "
+                         "BFTKV_RECORDER_DIR / <tmp>/bftkv-blackbox) and "
+                         "print its path")
+    ap.add_argument("--recorder", default="", metavar="DIR",
+                    help="watch/listen: attach the flight recorder — every "
+                         "anomaly snapshots a rate-limited, size-capped "
+                         "black-box bundle under DIR, and POST "
+                         "/fleet/bundle serves demand snapshots")
+    ap.add_argument("--profile", type=float, default=0.0, metavar="SECONDS",
+                    help="one-shot: also pull an N-second collapsed-stack "
+                         "profile from every HTTP target (/profile)")
     args = ap.parse_args(argv)
 
     targets = [t for t in args.targets.split(",") if t.strip()]
@@ -202,11 +272,15 @@ def main(argv: list[str] | None = None) -> int:
               file=sys.stderr)
         return 2
 
-    collector = FleetCollector(
-        [HTTPSource(t) for t in targets], interval=args.interval
-    )
+    sources = [HTTPSource(t) for t in targets]
+    collector = FleetCollector(sources, interval=args.interval)
 
     if args.listen or args.watch:
+        if args.recorder:
+            from bftkv_tpu.obs.recorder import FlightRecorder
+
+            rec = FlightRecorder(args.recorder).add_to(collector)
+            print(f"fleet: flight recorder @ {rec.dir}", flush=True)
         collector.start(args.interval)
         httpd = None
         if args.listen:
@@ -228,14 +302,63 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     doc = None
-    for i in range(max(args.scrapes, 1)):
+    scrapes = max(args.scrapes, 2 if args.budget else 1)
+    for i in range(scrapes):
         if i:
             time.sleep(args.interval)
         doc = collector.scrape_once()
+    profiles = None
+    if args.profile > 0:
+        # Each /profile request BLOCKS for the window; the windows are
+        # independent daemons' — capture them concurrently so the
+        # one-shot costs ~one window, not members x window.
+        import threading
+
+        results = [""] * len(sources)
+
+        def pull(i: int, src) -> None:
+            try:
+                results[i] = src.profile(args.profile)
+            except Exception as e:
+                results[i] = f"# profile failed: {e}\n"
+
+        threads = [
+            threading.Thread(target=pull, args=(i, s), daemon=True)
+            for i, s in enumerate(sources)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        profiles = {
+            src.name or src.base: text
+            for src, text in zip(sources, results)
+        }
+    bundle_path = None
+    if args.bundle is not None:
+        from bftkv_tpu.obs.recorder import FlightRecorder
+
+        rec = FlightRecorder(args.bundle or None, collector=collector)
+        bundle_path = rec.snapshot(reason="demand")
     if args.json:
+        # One parseable document on stdout, always: --profile/--bundle
+        # results ride INSIDE it rather than trailing it (which would
+        # break every `--json | jq .` consumer with Extra data).
+        doc = dict(doc)
+        if profiles is not None:
+            doc["profiles"] = profiles
+        if bundle_path is not None:
+            doc["bundle"] = bundle_path
         print(json.dumps(doc, indent=1, sort_keys=True, default=str))
     else:
         print(render(doc))
+        if args.budget:
+            print(render_budget(doc))
+        for name, text in (profiles or {}).items():
+            print(f"--- profile {name} ---")
+            print(text, end="")
+        if bundle_path is not None:
+            print(f"bundle: {bundle_path}")
     return _exit_code(doc)
 
 

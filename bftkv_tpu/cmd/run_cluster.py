@@ -66,6 +66,7 @@ def spawn(
     chaos_seed: int | None = None,
     fleet: int = 0,
     fleet_interval: float = 2.0,
+    recorder: str = "",
     autopilot: bool = False,
     gw_homes: list[str] | None = None,
     gw_sync_invalidate: float = 5.0,
@@ -190,24 +191,23 @@ def spawn(
         # The health plane rides alongside the fleet: one collector
         # process scraping every daemon's (and gateway's) /info +
         # /metrics + /trace, serving the aggregate on /fleet
-        # (bftkv_tpu.obs).
-        procs.append(
-            subprocess.Popen(
-                [
-                    sys.executable, "-m", "bftkv_tpu.cmd.fleet",
-                    "--api-base", str(api_base),
-                    "--count", str(
-                        len(homes)
-                        + len(gw_homes or [])
-                        + (1 if sidecar_stats else 0)
-                    ),
-                    "--api-host", api_host,
-                    "--listen", f"127.0.0.1:{fleet}",
-                    "--interval", str(fleet_interval),
-                ],
-                env=env,
-            )
-        )
+        # (bftkv_tpu.obs).  --recorder attaches the flight recorder to
+        # it: anomalies snapshot black-box bundles under that dir.
+        cmd = [
+            sys.executable, "-m", "bftkv_tpu.cmd.fleet",
+            "--api-base", str(api_base),
+            "--count", str(
+                len(homes)
+                + len(gw_homes or [])
+                + (1 if sidecar_stats else 0)
+            ),
+            "--api-host", api_host,
+            "--listen", f"127.0.0.1:{fleet}",
+            "--interval", str(fleet_interval),
+        ]
+        if recorder:
+            cmd += ["--recorder", recorder]
+        procs.append(subprocess.Popen(cmd, env=env))
     if autopilot:
         # Advisory watcher over the collector's /fleet document: prints
         # retire/split decisions as JSON lines (BFTKV_AUTOPILOT=off
@@ -289,6 +289,13 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--fleet-interval", type=float, default=2.0,
                     metavar="SECONDS",
                     help="collector scrape interval")
+    ap.add_argument("--recorder", default="", metavar="DIR",
+                    help="attach the flight recorder to the --fleet "
+                         "collector: every anomaly snapshots a rate-"
+                         "limited black-box bundle (traces, metrics, "
+                         "anomaly ring, failpoint log, last profile) "
+                         "under DIR; POST /fleet/bundle takes one on "
+                         "demand (needs --fleet)")
     ap.add_argument("--autopilot", action="store_true",
                     help="boot the topology autopilot watcher beside "
                          "the fleet collector (needs --fleet): it "
@@ -334,6 +341,10 @@ def main(argv: list[str] | None = None) -> int:
         print("--autopilot needs --fleet (it watches the collector's "
               "/fleet document)", file=sys.stderr)
         return 1
+    if args.recorder and not args.fleet:
+        print("--recorder needs --fleet (the recorder hangs off the "
+              "collector's anomaly feed)", file=sys.stderr)
+        return 1
     gw_homes = gateway_homes(args.keys)[: args.gateways]
     if args.gateways and len(gw_homes) < args.gateways:
         print(f"--gateways {args.gateways} but only {len(gw_homes)} gw* "
@@ -350,6 +361,7 @@ def main(argv: list[str] | None = None) -> int:
                   rpc_timeout=args.rpc_timeout,
                   chaos_seed=args.chaos_seed,
                   fleet=args.fleet, fleet_interval=args.fleet_interval,
+                  recorder=args.recorder,
                   autopilot=args.autopilot, gw_homes=gw_homes)
     if args.fleet:
         print(f"run_cluster: fleet health @ http://127.0.0.1:{args.fleet}"

@@ -69,7 +69,7 @@ from bftkv_tpu.cmd.verify_sidecar import (
 from bftkv_tpu.crypto import cert as certmod
 from bftkv_tpu.crypto import rsa
 from bftkv_tpu.metrics import registry as metrics
-from bftkv_tpu import flags
+from bftkv_tpu import flags, trace
 from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = [
@@ -150,6 +150,19 @@ class SidecarChannel:
         now open); otherwise the authenticated ``(status, payload)``."""
         if self.tripped():
             return None
+        if trace.capture() is not None:
+            # Inside a request trace, the shared-service round trip is
+            # its own budget phase — a slow write queueing behind
+            # another tenant's batch shows up HERE, not as mystery
+            # "server" time (DESIGN.md §18).
+            with trace.span(
+                "sidecar.call",
+                attrs={"op": op, "bytes": len(payload)},
+            ):
+                return self._request(op, payload)
+        return self._request(op, payload)
+
+    def _request(self, op: int, payload: bytes) -> tuple[int, bytes] | None:
         body = encode_op(op, payload)
         if self._secret is not None:
             body += request_tag(self._secret, body)

@@ -42,6 +42,7 @@ import random
 import threading
 import time
 
+from bftkv_tpu import flags
 from bftkv_tpu.faults import byzantine, failpoint as fp
 from bftkv_tpu.faults.checker import SafetyChecker
 from bftkv_tpu.faults.harness import ChaosCluster, build_cluster
@@ -136,6 +137,18 @@ class SidecarHarness:
         dispatch.uninstall_all()
 
 
+#: Anomaly kinds that validly evidence each fault kind in a window's
+#: flight-recorder bundle — the mirror of hit()'s own acceptance in
+#: _window_check (which of them lands first is a race between the
+#: failpoint echo, the counter-delta feeds, and member-state scrapes).
+_BUNDLE_OK_KINDS: dict[str, set] = {
+    "route_flap": {"epoch_skew"},
+    "sidecar_crash": {"sidecar_down", "sidecar_dishonest"},
+    "crash_restart": {"member_down"},
+    "slow_node": {"fault", "gray_member"},
+}
+
+
 class Nemesis:
     def __init__(
         self,
@@ -169,6 +182,12 @@ class Nemesis:
         #: its window (built in :meth:`run`; None = detection off).
         self.collector = None
         self.detection: list[dict] = []
+        #: Flight recorder under test (``BFTKV_FLIGHT_RECORDER=1``):
+        #: every fault window must yield exactly ONE black-box bundle
+        #: whose manifest names the detected anomaly — the "what did
+        #: the box look like when it broke" oracle (DESIGN.md §18).
+        self.recorder = None
+        self.recorder_missing: list[dict] = []
         #: slow_node windows where a write failed: a gray member inside
         #: the f budget must never BLOCK commit — slower is fine,
         #: failed is a violation (the acceptance bar of DESIGN.md §13).
@@ -616,12 +635,24 @@ class Nemesis:
         so the window's last RPC — the one that trips the rule on the
         target — may still be in flight when traffic() returns; the
         bounded re-scrape below IS the "one interval" allowance, and
-        the fault stays armed throughout."""
+        the fault stays armed throughout.
+
+        ``hit()`` returns the MATCHED anomaly kind (or a vacuous
+        marker), not just a bool: the flight-recorder oracle below
+        needs to know which anomaly this window's bundle must name."""
         if self.collector is None:
             return
         kind, target = step["kind"], step["target"]
+        rec = self.recorder
+        bundles0: set = set()
+        if rec is not None:
+            # New coalescing epoch: this window's anomalies mint ONE
+            # fresh bundle (follow-ups amend it), never share the
+            # previous window's.
+            rec.mark_window()
+            bundles0 = set(rec.bundles())
 
-        def hit() -> bool:
+        def hit() -> str | None:
             fresh = self.collector.anomalies(since_seq=seq0)
             if kind == "route_flap":
                 # The stale-routed client's declined writes surface as
@@ -629,16 +660,18 @@ class Nemesis:
                 # epoch_skew anomaly (source is the process-wide
                 # metrics feed on loopback clusters, so kind alone is
                 # the match).
-                return any(a["kind"] == "epoch_skew" for a in fresh)
+                if any(a["kind"] == "epoch_skew" for a in fresh):
+                    return "epoch_skew"
+                return None
             if kind == "sidecar_crash":
                 # The crypto service died: tenants must notice — the
                 # breaker-open counter delta maps to sidecar_down in
                 # the feed (sidecar_dishonest would also count: either
                 # way the plane flagged the service).
-                return any(
-                    a["kind"] in ("sidecar_down", "sidecar_dishonest")
-                    for a in fresh
-                )
+                for a in fresh:
+                    if a["kind"] in ("sidecar_down", "sidecar_dishonest"):
+                        return a["kind"]
+                return None
             if kind == "crash_restart":
                 # The plane "sees" an outage either as a fresh
                 # member_down transition or as the member simply BEING
@@ -647,11 +680,13 @@ class Nemesis:
                 # so the transition alone would under-report.
                 m = self.collector.members.get(target)
                 if m is not None and m.status == "down":
-                    return True
-                return any(
+                    return "member_down"
+                if any(
                     a["kind"] == "member_down" and a["source"] == target
                     for a in fresh
-                )
+                ):
+                    return "member_down"
+                return None
             if kind == "slow_node":
                 # A gray member surfaces three ways: the injected-fault
                 # echo (fp registry); a gray_member anomaly from the
@@ -671,16 +706,15 @@ class Nemesis:
                 except Exception:
                     addr = ""
                 if addr and _tp.peer_latency.is_gray(addr):
-                    return True
-                if any(
-                    (a["kind"] == "fault" and a["source"] == target)
-                    or (
+                    return "gray_member"
+                for a in fresh:
+                    if a["kind"] == "fault" and a["source"] == target:
+                        return "fault"
+                    if (
                         a["kind"] == "gray_member"
                         and target in a["detail"]
-                    )
-                    for a in fresh
-                ):
-                    return True
+                    ):
+                        return "gray_member"
                 # Vacuous window: the delay rule never FIRED — health-
                 # aware staging (or an earlier gray verdict whose flag
                 # has since decayed) kept every post off the target.
@@ -694,13 +728,15 @@ class Nemesis:
                     and e.seq > step.get("_fp_seq0", 0)
                     for e in self.registry.trace()
                 )
-                return not fired
-            return any(
+                return None if fired else "vacuous"
+            if any(
                 a["kind"] == "fault" and a["source"] == target
                 for a in fresh
-            )
+            ):
+                return "fault"
+            return None
 
-        detected = False
+        matched = None
         # Generous tail (~6 s worst case, first scrape usually wins):
         # under 2-CPU contention an abandoned straggler post — the one
         # carrying the only RPC that trips the rule on the target — can
@@ -709,13 +745,54 @@ class Nemesis:
             if attempt:
                 time.sleep(0.25)
             self.collector.scrape_once()
-            if hit():
-                detected = True
+            matched = hit()
+            if matched:
                 break
-        self.detection.append(
-            {"step": step["step"], "kind": kind, "target": target,
-             "detected": detected}
-        )
+        entry = {
+            "step": step["step"], "kind": kind, "target": target,
+            "detected": matched is not None, "anomaly": matched,
+        }
+        if rec is not None and matched and matched != "vacuous":
+            # The bundle-per-fault oracle: this window must have minted
+            # exactly one bundle whose manifest names the matched
+            # anomaly.  Detections via member STATE (down/gray at
+            # scrape, no fresh anomaly event) take a demand snapshot
+            # naming the verdict — the black box records what the
+            # plane concluded, however it concluded it.
+            new = sorted(set(rec.bundles()) - bundles0)
+            if not new:
+                rec.snapshot(
+                    reason=f"step{step['step']}-{kind}",
+                    anomalies=[{
+                        "kind": matched,
+                        "source": target,
+                        "detail": "state-detected at scrape",
+                    }],
+                )
+                new = sorted(set(rec.bundles()) - bundles0)
+            kinds: set = set()
+            for b in new:
+                try:
+                    from bftkv_tpu.obs.recorder import read_manifest
+
+                    kinds.update(
+                        str(a.get("kind"))
+                        for a in read_manifest(b).get("anomalies", [])
+                    )
+                except (OSError, ValueError):
+                    pass
+            entry["bundles"] = len(new)
+            entry["bundle_anomalies"] = sorted(kinds)
+            # Any anomaly kind that validly evidences THIS fault kind
+            # satisfies the oracle, not just the one hit() matched
+            # first: a slow_node verdict may be state-detected as
+            # gray_member while the window's bundle was minted by the
+            # equally-valid "fault" echo event — that bundle IS the
+            # black box of this window, not a miss.
+            ok_kinds = _BUNDLE_OK_KINDS.get(kind, {"fault"}) | {matched}
+            if len(new) != 1 or not (kinds & ok_kinds):
+                self.recorder_missing.append(dict(entry))
+        self.detection.append(entry)
 
     # -- one full run ------------------------------------------------------
 
@@ -900,8 +977,28 @@ class Nemesis:
         self.detection = []  # a re-run must not inherit stale verdicts
         self.gray_blocked = []
         self.sidecar_blocked = []
+        self.recorder_missing = []
         self._migration = None
         self.collector = self._make_collector() if detect else None
+        self.recorder = None
+        if self.collector is not None and flags.enabled(
+            "BFTKV_FLIGHT_RECORDER"
+        ):
+            import tempfile
+
+            from bftkv_tpu.obs.recorder import FlightRecorder
+
+            rdir = flags.raw("BFTKV_RECORDER_DIR") or tempfile.mkdtemp(
+                prefix="bftkv-nemesis-blackbox-"
+            )
+            # Bundle-count cap must clear the schedule: one bundle per
+            # fault window is the oracle, eviction mid-run would fake a
+            # missing bundle.
+            self.recorder = FlightRecorder(
+                rdir,
+                fp_registry=self.registry,
+                max_bundles=max(2 * steps + 8, 32),
+            ).add_to(self.collector)
         self.autopilot = None
         if self._want_autopilot:
             from bftkv_tpu.autopilot import Autopilot
@@ -1019,6 +1116,17 @@ class Nemesis:
             "undetected": [d for d in self.detection if not d["detected"]],
             "gray_blocked": self.gray_blocked,
             "sidecar_blocked": self.sidecar_blocked,
+            "recorder": (
+                {
+                    "dir": self.recorder.dir,
+                    "bundles": self.recorder.bundle_count,
+                    "coalesced": self.recorder.coalesced,
+                    "missing": self.recorder_missing,
+                }
+                if self.recorder is not None
+                else None
+            ),
+            "recorder_missing": self.recorder_missing,
             "anomalies": (
                 len(self.collector.anomalies())
                 if self.collector is not None
@@ -1132,6 +1240,7 @@ def main(argv: list[str] | None = None) -> int:
         or report["undetected"]
         or report["gray_blocked"]
         or report["sidecar_blocked"]
+        or report["recorder_missing"]
         or lockwatch_msg
     )
     if args.json:
@@ -1179,6 +1288,19 @@ def main(argv: list[str] | None = None) -> int:
             f"{s['failed_writes']} write(s) — a dead crypto sidecar "
             "must degrade to local crypto, never block a write"
         )
+    if report.get("recorder"):
+        r = report["recorder"]
+        print(
+            f"flight recorder: {r['bundles']} bundle(s) "
+            f"({r['coalesced']} coalesced) under {r['dir']}"
+        )
+    for rm in report["recorder_missing"]:
+        print(
+            f"NO BUNDLE: step {rm['step']} {rm['kind']} on "
+            f"{rm['target']} detected as {rm['anomaly']} but the window "
+            f"minted {rm.get('bundles', 0)} bundle(s) naming "
+            f"{rm.get('bundle_anomalies', [])} — the black box missed it"
+        )
     if report["violations"]:
         print("nemesis: SAFETY VIOLATIONS FOUND")
         return 1
@@ -1193,6 +1315,9 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     if report["sidecar_blocked"]:
         print("nemesis: SIDECAR DEATH BLOCKED WRITES")
+        return 1
+    if report["recorder_missing"]:
+        print("nemesis: FAULT WINDOWS WITHOUT A FLIGHT-RECORDER BUNDLE")
         return 1
     if lockwatch_msg:
         print(lockwatch_msg)

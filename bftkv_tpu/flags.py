@@ -249,6 +249,34 @@ _flag("BFTKV_LOCKWATCH", "", "switch",
       "Opt-in runtime lock sanitizer: records the lock acquisition-"
       "order graph, reports lock-order cycles and blocking calls "
       "under storage/metrics/route locks (DESIGN.md §16).")
+_flag("BFTKV_PROFILE", "", "switch",
+      "Opt-in continuous wall-clock sampling profiler (collapsed "
+      "flamegraph stacks served on /profile; DESIGN.md §18).  Off = "
+      "no sampler thread, zero overhead.")
+_flag("BFTKV_PROFILE_HZ", "67", "int",
+      "Sampling rate of the continuous profiler (prime default so the "
+      "comb never phase-locks to periodic work).")
+_flag("BFTKV_SLO_WRITE_P99", None, "float",
+      "Write-latency SLO in seconds: a shard whose per-scrape write "
+      "p99 exceeds it for BFTKV_SLO_BURN_SCRAPES consecutive scrapes "
+      "raises the slo_burn anomaly (unset: disabled).")
+_flag("BFTKV_SLO_BURN_SCRAPES", "3", "int",
+      "Consecutive breaching scrapes before slo_burn fires — the "
+      "hysteresis that keeps one slow scrape from paging anyone.")
+_flag("BFTKV_FLIGHT_RECORDER", "", "switch",
+      "Arm the flight recorder in the chaos nemesis: every fault "
+      "window must yield exactly one black-box bundle naming the "
+      "detected anomaly, enforced via the nemesis exit code.")
+_flag("BFTKV_RECORDER_DIR", None, "str",
+      "Flight-recorder bundle directory (unset: <tmpdir>/"
+      "bftkv-blackbox).")
+_flag("BFTKV_RECORDER_MIN_INTERVAL", "5", "float",
+      "Seconds within which anomaly events coalesce into (amend) the "
+      "previous bundle instead of minting a new one — the flapping-"
+      "anomaly disk bound.")
+_flag("BFTKV_RECORDER_MAX_MB", "64", "int",
+      "Total on-disk cap across flight-recorder bundles; oldest "
+      "bundles are evicted first.")
 
 # ---------------------------------------------------------------------------
 # The read seam.

@@ -25,14 +25,33 @@ a shared observability plane):
   fixed-bucket SLO histograms with slow-trace exemplars, and an
   anomaly feed (counter deltas, membership transitions, failpoint
   events);
-- :mod:`bftkv_tpu.obs.http` — ``/fleet`` as JSON and Prometheus text.
+- :mod:`bftkv_tpu.obs.http` — ``/fleet`` as JSON and Prometheus text;
+- :mod:`bftkv_tpu.obs.critpath` — exclusive-time decomposition of each
+  stitched write/read trace over the closed ``trace.PHASES`` enum,
+  aggregated into mergeable per-shard phase histograms with a p99
+  exemplar (``/fleet`` ``write_budget_by_phase``, DESIGN.md §18);
+- :mod:`bftkv_tpu.obs.profiler` — opt-in wall-clock sampling profiler
+  (collapsed flamegraph stacks, ``/profile?seconds=N`` per daemon);
+- :mod:`bftkv_tpu.obs.recorder` — the flight recorder: anomaly-driven,
+  rate-limited, size-capped black-box bundles of every diagnostic ring.
 
 Entry points: ``python -m bftkv_tpu.cmd.fleet`` (one-shot, ``--watch``,
-``--listen``) and ``run_cluster --fleet``.  Design: docs/DESIGN.md §11.
+``--listen``, ``--budget``, ``--bundle``) and ``run_cluster --fleet``.
+Design: docs/DESIGN.md §11 (health plane) + §18 (diagnosis tier).
 """
 
 from bftkv_tpu.obs.collector import FleetCollector
+from bftkv_tpu.obs.critpath import PhaseBudget, attribute
+from bftkv_tpu.obs.recorder import FlightRecorder
 from bftkv_tpu.obs.source import HTTPSource, LocalSource
 from bftkv_tpu.obs.stitch import Stitcher
 
-__all__ = ["FleetCollector", "HTTPSource", "LocalSource", "Stitcher"]
+__all__ = [
+    "FleetCollector",
+    "FlightRecorder",
+    "HTTPSource",
+    "LocalSource",
+    "PhaseBudget",
+    "Stitcher",
+    "attribute",
+]

@@ -36,9 +36,11 @@ from __future__ import annotations
 import re
 import threading
 import time
-from collections import deque
+from collections import OrderedDict, deque
 
+from bftkv_tpu import flags
 from bftkv_tpu.metrics import BUCKETS, histogram_quantile
+from bftkv_tpu.obs.critpath import ROOT_OPS, PhaseBudget, attribute
 from bftkv_tpu.obs.stitch import Stitcher
 from bftkv_tpu.devtools.lockwatch import named_lock
 
@@ -118,6 +120,8 @@ class _Member:
         "scrape_s",
         "cursor",
         "prev_counters",
+        "ring_dropped",
+        "slow_dropped",
     )
 
     def __init__(self, source):
@@ -133,6 +137,10 @@ class _Member:
         self.scrape_s = 0.0
         self.cursor = 0
         self.prev_counters: dict = {}
+        #: Cumulative trace-ring overwrite counts the member self-
+        #: reports on /trace — the fleet-wide under-sampling signal.
+        self.ring_dropped = 0
+        self.slow_dropped = 0
 
 
 class FleetCollector:
@@ -175,6 +183,27 @@ class FleetCollector:
         self._slo: dict = {}  # (shard, op) -> merged bucket vector
         self._slo_sums: dict = {}  # (shard, op) -> merged latency sum
         self._exemplars: dict = {}  # shard -> deque of slow entries
+        #: Critical-path attribution (DESIGN.md §18): per-(op, shard)
+        #: phase budgets over the stitched traces.
+        self.budget = PhaseBudget()
+        #: trace id -> scrape index its root was first seen.  A trace
+        #: is attributed one full scrape AFTER its root appears, so
+        #: server-side fragments scraped from other daemons in between
+        #: make it into the tree (bounded; overflow = oldest dropped,
+        #: counted so under-sampling is visible, never silent).
+        self._attr_pending: "OrderedDict[str, int]" = OrderedDict()
+        self._attr_dropped = 0
+        #: SLO burn-rate state: previous merged write vectors (per-
+        #: scrape deltas are the burn signal — cumulative histograms
+        #: stop moving once counts are large) + per-shard consecutive
+        #: breach counts with hysteresis.
+        self._burn_prev: dict = {}
+        self._burn_count: dict = {}
+        self._local_ring_dropped = 0
+        self._local_slow_dropped = 0
+        #: Anomaly listeners (the flight recorder's feed), called
+        #: OUTSIDE the collector lock — a listener may read health().
+        self._listeners: list = []
         self._thread: threading.Thread | None = None
         self._stop = threading.Event()
         #: Optional zero-arg callable set by an attached topology
@@ -184,26 +213,125 @@ class FleetCollector:
 
     # -- anomaly feed ------------------------------------------------------
 
+    def add_anomaly_listener(self, fn) -> None:
+        """``fn(anomaly_dict)`` on every fresh anomaly — the flight
+        recorder's anomaly→bundle path.  Called OUTSIDE the collector
+        lock (a listener may call :meth:`health`/:meth:`anomalies`); a
+        raising listener never takes the scrape down."""
+        with self._lock:
+            self._listeners.append(fn)
+
     def _emit(self, kind: str, source: str, shard, detail: str, count=1):
         with self._lock:
             self._anomaly_seq += 1
-            self._anomalies.append(
-                {
-                    "seq": self._anomaly_seq,
-                    "ts": time.time(),
-                    "kind": kind,
-                    "source": source,
-                    "shard": shard,
-                    "detail": detail,
-                    "count": count,
-                }
-            )
+            anomaly = {
+                "seq": self._anomaly_seq,
+                "ts": time.time(),
+                "kind": kind,
+                "source": source,
+                "shard": shard,
+                "detail": detail,
+                "count": count,
+            }
+            self._anomalies.append(anomaly)
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(anomaly)
+            except Exception:
+                pass  # a broken black box must not break detection
 
     def anomalies(self, since_seq: int = 0, limit: int = 200) -> list[dict]:
         with self._lock:
             return [a for a in self._anomalies if a["seq"] > since_seq][
                 -limit:
             ]
+
+    # -- critical-path attribution (DESIGN.md §18) -------------------------
+
+    ATTR_PENDING_MAX = 2048
+
+    def _note_roots(self, spans: list) -> None:
+        """Mark every fresh write/read root trace for attribution ONE
+        scrape later — the deferral that lets server-side fragments
+        from other daemons join the tree first."""
+        with self._lock:
+            cur = self._scrapes
+            for s in spans:
+                if "parent" not in s and s.get("name") in ROOT_OPS:
+                    tid = s.get("trace")
+                    if tid and tid not in self._attr_pending:
+                        self._attr_pending[tid] = cur
+                        while len(self._attr_pending) > self.ATTR_PENDING_MAX:
+                            self._attr_pending.popitem(last=False)
+                            self._attr_dropped += 1
+
+    def _ingest_spans(self, who: str, texp: dict, m=None) -> None:
+        """One source's trace export → stitcher + root marking + the
+        member's self-reported ring-drop counters."""
+        spans = texp.get("spans") or []
+        self.stitcher.add(who, spans)
+        self._note_roots(spans)
+        if m is not None:
+            m.ring_dropped = texp.get("ring_dropped", m.ring_dropped)
+            m.slow_dropped = texp.get("slow_dropped", m.slow_dropped)
+
+    def _attribute_pass(self) -> None:
+        """Attribute every due trace (root seen at least one full
+        scrape ago) into the per-(op, shard) phase budgets."""
+        with self._lock:
+            cur = self._scrapes
+            due = [t for t, sc in self._attr_pending.items() if sc < cur]
+            for tid in due:
+                del self._attr_pending[tid]
+        for tid in due:
+            spans = self.stitcher.spans(tid)
+            if not spans:
+                continue  # evicted before its turn: under-sampled, not wrong
+            breakdown = attribute(spans)
+            if breakdown is not None:
+                self.budget.observe(breakdown)
+
+    # -- SLO burn rate (hysteresis; ISSUE 15 satellite) --------------------
+
+    def _slo_burn_check(self, slo_counts: dict) -> None:
+        """``slo_burn`` when a shard's PER-SCRAPE write p99 (delta of
+        the merged bucket vectors — cumulative histograms stop moving
+        once counts are large) exceeds ``BFTKV_SLO_WRITE_P99`` for k
+        consecutive traffic-bearing scrapes.  One slow scrape never
+        fires it; a clean scrape re-arms."""
+        thr = flags.get_float("BFTKV_SLO_WRITE_P99")
+        if thr is None:
+            return
+        if not slo_counts:
+            return  # no merged histograms at all: nothing to judge
+        k = max(flags.get_int("BFTKV_SLO_BURN_SCRAPES") or 3, 1)
+        for (sh, op), vec in slo_counts.items():
+            if op != "write":
+                continue
+            prev = self._burn_prev.get((sh, op))
+            delta = [
+                c - (prev[i] if prev and i < len(prev) else 0)
+                for i, c in enumerate(vec)
+            ]
+            if sum(delta) <= 0:
+                # No fresh writes this scrape (or a restart shrank the
+                # merge): neither a breach nor a recovery — the burn
+                # count holds, idle time can't page or un-page anyone.
+                continue
+            p99 = histogram_quantile(0.99, delta)
+            if p99 is not None and p99 > thr:
+                n = self._burn_count.get(sh, 0) + 1
+                self._burn_count[sh] = n
+                if n == k:  # fires ONCE per burn episode
+                    self._emit(
+                        "slo_burn", "collector", sh,
+                        f"write p99_le {p99:g}s > slo {thr:g}s "
+                        f"for {k} consecutive scrapes",
+                    )
+            else:
+                self._burn_count[sh] = 0  # recovery re-arms
+        self._burn_prev = {key: list(v) for key, v in slo_counts.items()}
 
     # -- scraping ----------------------------------------------------------
 
@@ -400,7 +528,7 @@ class FleetCollector:
                     name = reported
             if ok:
                 m.cursor = texp.get("cursor", m.cursor)
-                self.stitcher.add(name, texp.get("spans") or [])
+                self._ingest_spans(name, texp, m)
                 shard = m.info.get("shard")
                 self._ingest_slow(name, shard, texp.get("slow"))
                 m.prev_counters = self._counter_deltas(
@@ -439,7 +567,9 @@ class FleetCollector:
         if self.local_tracer is not None:
             texp = self.local_tracer.export(self._local_cursor)
             self._local_cursor = texp["cursor"]
-            self.stitcher.add("process", texp["spans"])
+            self._ingest_spans("process", texp)
+            self._local_ring_dropped = texp.get("ring_dropped", 0)
+            self._local_slow_dropped = texp.get("slow_dropped", 0)
             self._ingest_slow("process", None, self.local_tracer.slow())
         if self.fp_registry is not None:
             events = self.fp_registry.trace()
@@ -457,6 +587,12 @@ class FleetCollector:
                     self._shard_of_member(target),
                     f"{ev.point}:{ev.rule_id}:{ev.kind}",
                 )
+
+        # Diagnosis tier (DESIGN.md §18): attribute every trace whose
+        # root has waited one full scrape, then judge the SLO burn rate
+        # on this scrape's delta — both AFTER every feed was ingested.
+        self._attribute_pass()
+        self._slo_burn_check(slo_counts)
 
         with self._lock:
             if slo_counts:
@@ -535,10 +671,13 @@ class FleetCollector:
         shards_doc: dict = {}
         now = time.time()
         all_members = self._members_snapshot()
+        budget_doc = self.budget.doc()
         with self._lock:
             slo = {k: list(v) for k, v in self._slo.items()}
             slo_sums = dict(self._slo_sums)
             exemplars = {k: list(v) for k, v in self._exemplars.items()}
+            attr_pending = len(self._attr_pending)
+            attr_dropped = self._attr_dropped
         for sh, members in sorted(
             self._shards(all_members).items(), key=lambda kv: str(kv[0])
         ):
@@ -600,6 +739,13 @@ class FleetCollector:
                     }
             doc["slo"] = slo_doc
             doc["exemplars"] = exemplars.get(sh, [])
+            # The phase budget of this shard's writes/reads: where the
+            # wall clock went, exclusive per phase, p99 exemplar first.
+            doc["budget"] = {
+                op: budget_doc[op][sh]
+                for op in ("write", "read")
+                if sh in budget_doc.get(op, {})
+            }
             shards_doc[str(sh)] = doc
 
         up = [n for n, m in all_members.items() if m.status == "up"]
@@ -642,8 +788,26 @@ class FleetCollector:
                     "max": epochs[-1] if epochs else None,
                     "skewed": len(epochs) > 1,
                 },
+                # Fleet-wide trace-ring overwrite totals: nonzero means
+                # attribution/stitching under-sample — turn down traffic
+                # per scrape or raise the ring (ISSUE 15 satellite).
+                "trace_drops": {
+                    "ring": self._local_ring_dropped + sum(
+                        m.ring_dropped for m in all_members.values()
+                    ),
+                    "slow": self._local_slow_dropped + sum(
+                        m.slow_dropped for m in all_members.values()
+                    ),
+                    "attr_pending": attr_pending,
+                    "attr_dropped": attr_dropped,
+                },
             },
             "autopilot": autopilot,
+            # The full attribution document, op → shard → budget (the
+            # per-shard copies above are views into this): where each
+            # op's wall clock went, exclusive per phase (DESIGN.md §18).
+            "write_budget_by_phase": budget_doc.get("write", {}),
+            "read_budget_by_phase": budget_doc.get("read", {}),
             "shards": shards_doc,
             "gateways": self._gateways(all_members, now),
             "sidecars": self._sidecars(all_members, now),
@@ -707,6 +871,9 @@ class FleetCollector:
                             str(q[field]))
         add("traces_stitched", "gauge", "",
             str(doc["traces"]["stitched"]))
+        drops = doc["fleet"].get("trace_drops") or {}
+        add("trace_ring_dropped", "gauge", "", str(drops.get("ring", 0)))
+        add("trace_slow_dropped", "gauge", "", str(drops.get("slow", 0)))
         add("anomalies_total", "counter", "", str(self._anomaly_seq))
         for sh, sd in sorted(doc["shards"].items()):
             lab = f'{{shard="{sh}"}}'
@@ -727,6 +894,28 @@ class FleetCollector:
                         f'_bucket{{shard="{sh}",le="{le}"}}', str(acc))
                 add(fam, "histogram", "_sum" + lab, str(s["sum_s"]))
                 add(fam, "histogram", "_count" + lab, str(s["count"]))
+        # Critical-path attribution: ONE histogram family labeled by
+        # (shard, op, phase) — ``bftkv_fleet_phase_seconds`` is the
+        # per-phase exclusive-time distribution (DESIGN.md §18).
+        # Emitted from the top-level attribution doc, so a budget
+        # survives even when no member's /info seated its shard.
+        for op in ("write", "read"):
+            for sh, b in sorted(
+                doc.get(f"{op}_budget_by_phase", {}).items(),
+                key=lambda kv: str(kv[0]),
+            ):
+                for phase, pd in sorted(b.get("phases", {}).items()):
+                    plab = f'shard="{sh}",op="{op}",phase="{phase}"'
+                    acc = 0
+                    for i, c in enumerate(pd["buckets"]):
+                        acc += c
+                        le = BUCKETS[i] if i < len(BUCKETS) else "+Inf"
+                        add("phase_seconds", "histogram",
+                            f'_bucket{{{plab},le="{le}"}}', str(acc))
+                    add("phase_seconds", "histogram",
+                        "_sum{" + plab + "}", str(pd["sum_s"]))
+                    add("phase_seconds", "histogram",
+                        "_count{" + plab + "}", str(b["count"]))
 
         lines: list[str] = []
         for base in order:

@@ -30,6 +30,35 @@ class _FleetHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    def do_POST(self):
+        collector = self.server.collector
+        try:
+            if self.path == "/fleet/bundle" or self.path.startswith(
+                "/fleet/bundle?"
+            ):
+                # Demand flight-recorder snapshot (cmd.fleet --bundle
+                # against a listening collector): POST-only — it writes
+                # to disk.
+                rec = getattr(collector, "recorder", None)
+                if rec is None:
+                    self._reply(
+                        404,
+                        b"no flight recorder attached "
+                        b"(cmd.fleet --recorder DIR)\n",
+                        "text/plain",
+                    )
+                    return
+                bundle = rec.snapshot(reason="demand")
+                self._reply(
+                    200,
+                    json.dumps({"bundle": bundle}).encode() + b"\n",
+                    "application/json",
+                )
+            else:
+                self._reply(404, b"unknown endpoint\n", "text/plain")
+        except Exception as e:  # operator surface: never die
+            self._reply(500, (str(e) + "\n").encode(), "text/plain")
+
     def do_GET(self):
         collector = self.server.collector
         path = self.path

@@ -88,6 +88,16 @@ class HTTPSource:
     def trace_export(self, cursor: int) -> dict:
         return self._get_json(f"/trace?since={cursor}")
 
+    def profile(self, seconds: float = 2.0) -> str:
+        """On-demand collapsed-stack profile window from the daemon's
+        ``/profile`` endpoint (``cmd.fleet --profile``; the request
+        blocks for the window, so the timeout stretches to cover it)."""
+        with urllib.request.urlopen(
+            self.base + f"/profile?seconds={seconds:g}",
+            timeout=self.timeout + seconds + 5.0,
+        ) as res:
+            return res.read().decode()
+
     def probe(self) -> bool:
         try:
             self._get_json("/info")

@@ -97,6 +97,14 @@ class Stitcher:
             )
         return out[-limit:]
 
+    def spans(self, trace_id: str) -> list[dict] | None:
+        """One trace's retained spans as a flat list (the critical-path
+        attribution input), or None when the trace was never seen or
+        already evicted."""
+        with self._lock:
+            t = self._traces.get(trace_id)
+            return list(t["spans"].values()) if t else None
+
     def tree(self, trace_id: str) -> dict | None:
         """One trace as a nested tree: ``{"name", "src", "duration",
         "attrs", "children": [...]}``.  Orphan fragments (parent span

@@ -318,7 +318,18 @@ class _BatchDispatcher:
                 self._cv.notify_all()
         if not running:
             return self._run_batch(items)
-        p.event.wait()
+        if trace.capture() is not None:
+            # Inside a request trace: the queue wait is the "dispatch"
+            # phase of that request's wall-clock budget (DESIGN.md §18).
+            # No active trace (background flushers, bench drivers) —
+            # skip the span rather than minting orphan roots.
+            with trace.span(
+                "dispatch.wait",
+                attrs={"items": len(items), "pool": self.name},
+            ):
+                p.event.wait()
+        else:
+            p.event.wait()
         metrics.observe(f"{self.name}.wait", time.perf_counter() - t0)
         if p.error is not None:
             raise p.error
@@ -412,6 +423,10 @@ class _BatchDispatcher:
                 "batch_size": len(flat),
                 "occupancy": round(occupancy, 4),
             },
+            # Dynamic name: declare the phase explicitly (the
+            # span-phase lint cannot resolve f-strings with no
+            # leading literal against trace.SPAN_PHASES).
+            phase="dispatch",
         ) as sp:
             try:
                 if len(flat) <= self.max_batch:

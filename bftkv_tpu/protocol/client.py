@@ -721,9 +721,15 @@ class Client(Protocol):
         one combined WRITE_SIGN round, bounded decline-driven retries.
         Raises ``_PiggybackFallback`` when the classic rounds must take
         over (legacy peers; a write race outlasting the retry budget)."""
-        t = t_fixed if t_fixed is not None else self._presession.next_t(
-            variable
-        )
+        if t_fixed is not None:
+            t = t_fixed
+        else:
+            # Budget phase "lease" (DESIGN.md §18): what the optimistic
+            # timestamp actually costs on the critical path — near-zero
+            # when the lease is warm, which is the claim item 3's
+            # offline-everything work needs a ruler for.
+            with trace.span("presession.lease"):
+                t = self._presession.next_t(variable)
         for attempt in range(_WS_RETRIES + 1):
             status, arg = self._ws_round(variable, value, t, proof)
             if status == "commit":

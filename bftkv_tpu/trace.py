@@ -39,6 +39,17 @@ Span-name taxonomy and label-cardinality rules: docs/DESIGN.md §7.
 ``BFTKV_TRACE=off`` disables collection (spans become no-ops and no
 trace context rides the wire); ``BFTKV_SLOW_TRACE_SECONDS`` sets the
 slow threshold (default 1.0).
+
+**Phases (DESIGN.md §18).**  Every span name resolves to exactly one
+member of the CLOSED :data:`PHASES` enum via :data:`SPAN_PHASES` — the
+vocabulary the critical-path attribution plane
+(:mod:`bftkv_tpu.obs.critpath`) decomposes a write's wall clock into.
+The registry is closed the same way ``metrics.LABEL_KEYS`` is: a new
+span name must either match a declared entry or pass an explicit
+``phase=`` (``tools/bftlint``'s ``span-phase`` rule rejects call sites
+that would silently land in the implicit ``other`` bucket, because an
+unattributed span is exactly the invisible latency this plane exists
+to kill).
 """
 
 from __future__ import annotations
@@ -54,15 +65,110 @@ from bftkv_tpu import flags
 from bftkv_tpu.devtools.lockwatch import named_lock
 
 __all__ = [
+    "PHASES",
+    "SPAN_PHASES",
     "Span",
     "SpanContext",
     "Tracer",
     "attach",
     "capture",
     "new_id",
+    "phase_of",
     "span",
     "tracer",
 ]
+
+#: The closed phase enum the write/read wall-clock budget decomposes
+#: into (ISSUE 15; DESIGN.md §18).  Adding a phase is a deliberate
+#: schema change: the fleet collector's merged histograms and the
+#: committed bench ``phase_budget`` trajectories key off these names.
+PHASES = (
+    "lease",     # presession/timestamp-lease work before the fan-out
+    "fanout",    # fan-out machinery: sealing, staging, wave bookkeeping
+    "rpc",       # on-the-wire time of peer RPCs (slowest-peer network)
+    "server",    # remote admission + verify + storage (stitched spans)
+    "dispatch",  # batching-dispatcher queue wait (collector + flush)
+    "sidecar",   # shared-crypto-service round trips
+    "combine",   # collective-signature combine/mint/verify (host side)
+    "backfill",  # async certified-record back-fill tail
+    "other",     # root self-time, quorum selection, uncategorized
+)
+
+#: Span name → phase.  Exact names win; a key ending in ``.`` is a
+#: prefix rule (``rpc.`` covers every ``rpc.<cmd>``).  CLOSED: a span
+#: name resolving to none of these lands in ``other`` at runtime, and
+#: ``tools/bftlint`` rejects the call site unless it passes an
+#: explicit ``phase=`` — new spans must declare their phase.
+SPAN_PHASES: dict[str, str] = {
+    # client roots + local bookkeeping
+    "client.write": "other",
+    "client.read": "other",
+    "client.read_certified": "other",
+    "client.write_many": "other",
+    "client.read_many": "other",
+    "quorum.select": "other",
+    "fault.delay": "other",
+    # presession / leases
+    "presession.": "lease",
+    # fan-out rounds (the span wraps the whole round; its rpc children
+    # own the wire time, so the self-time left here is the fan-out
+    # machinery itself)
+    "phase.time": "fanout",
+    "phase.sign": "fanout",
+    "phase.write": "fanout",
+    "phase.write_sign": "fanout",
+    "read.certify": "fanout",
+    "read.certified_only": "fanout",
+    "read.certified_record": "fanout",
+    # per-peer wire time
+    "rpc.": "rpc",
+    # remote side (stitched into the client's trace)
+    "server.": "server",
+    "storage.write": "server",
+    # collective-signature host crypto
+    "phase.ack": "combine",
+    "verify.collective": "combine",
+    # batching dispatcher + shared crypto service
+    "dispatch.wait": "dispatch",
+    "verify.flush": "dispatch",
+    "sign.flush": "dispatch",
+    "modexp.flush": "dispatch",
+    "sidecar.call": "sidecar",
+    # async tails + repair/anti-entropy planes
+    "backfill.": "backfill",
+    "sync.repair.backfill": "backfill",
+    "sync.": "other",
+    # edge gateway (own roots; their quorum-client children re-enter
+    # the client.* taxonomy above)
+    "gateway.": "other",
+    "gateway_client.": "other",
+}
+
+#: Longest-match prefix rules, precomputed (longest first so
+#: ``sync.repair.backfill`` beats ``sync.``).
+_PREFIX_RULES = sorted(
+    (k for k in SPAN_PHASES if k.endswith(".")),
+    key=len, reverse=True,
+)
+
+_phase_memo: dict[str, str] = {}
+
+
+def phase_of(name: str) -> str:
+    """The declared phase of span ``name`` (``other`` for names outside
+    the registry — bftlint keeps that set empty in-tree)."""
+    p = _phase_memo.get(name)
+    if p is None:
+        p = SPAN_PHASES.get(name)
+        if p is None:
+            for prefix in _PREFIX_RULES:
+                if name.startswith(prefix):
+                    p = SPAN_PHASES[prefix]
+                    break
+            else:
+                p = "other"
+        _phase_memo[name] = p
+    return p
 
 slow_log = logging.getLogger("bftkv_tpu.trace.slow")
 
@@ -97,10 +203,12 @@ class Span:
         "duration",
         "attrs",
         "seq",
+        "phase",
         "_t0",
     )
 
-    def __init__(self, trace_id, span_id, parent_id, name, attrs):
+    def __init__(self, trace_id, span_id, parent_id, name, attrs,
+                 phase=None):
         self.trace_id = trace_id
         self.span_id = span_id
         self.parent_id = parent_id
@@ -109,6 +217,9 @@ class Span:
         self.duration = 0.0
         self.attrs = attrs
         self.seq = 0  # assigned by Tracer.record under its lock
+        #: Explicit phase override (dynamic-named spans); None =
+        #: resolve from the SPAN_PHASES registry at export time.
+        self.phase = phase
         self._t0 = time.perf_counter()
 
     def context(self) -> SpanContext:
@@ -121,6 +232,9 @@ class Span:
             "name": self.name,
             "start": self.start,
             "duration": self.duration,
+            # Resolved lazily (exports are rare next to records) so the
+            # record hot path never pays the registry lookup.
+            "phase": self.phase or phase_of(self.name),
         }
         if self.parent_id is not None:
             d["parent"] = f"{self.parent_id:016x}"
@@ -185,11 +299,13 @@ class span:
     ``attrs["error"]`` (interned error message when available) and
     still propagates."""
 
-    __slots__ = ("name", "attrs", "_sp")
+    __slots__ = ("name", "attrs", "phase", "_sp")
 
-    def __init__(self, name: str, attrs: dict | None = None):
+    def __init__(self, name: str, attrs: dict | None = None,
+                 phase: str | None = None):
         self.name = name
         self.attrs = attrs
+        self.phase = phase
 
     def __enter__(self) -> Span:
         if not tracer.enabled:
@@ -206,7 +322,8 @@ class span:
             else:
                 trace_id, parent_id = new_id(), None
         sp = Span(trace_id, new_id(), parent_id, self.name,
-                  dict(self.attrs) if self.attrs else {})
+                  dict(self.attrs) if self.attrs else {},
+                  phase=self.phase)
         st.append(sp)
         self._sp = sp
         return sp
@@ -253,6 +370,20 @@ class Tracer:
         # Survives ring wrap-around: a drained reader can tell exactly
         # how many spans it lost to overwrite (export()'s "dropped").
         self._seq = 0
+        # Cumulative ring-overwrite counts (spans/slow entries pushed
+        # off the bounded rings before ANY reader drained them) —
+        # attribution silently under-samples by exactly these, so they
+        # ride every export doc and the trace.ring.dropped /
+        # trace.slow.dropped gauges the fleet plane sums (ISSUE 15).
+        # Reader-relative on purpose: a full ring whose tail every
+        # scrape keeps up with loses nothing — counting raw evictions
+        # would turn the gauge permanently nonzero on any long-lived
+        # busy daemon and cry wolf forever.
+        self._ring_dropped = 0
+        self._slow_dropped = 0
+        self._drained_to = 0  # highest seq any export() has covered
+        self._slow_seq = 0  # monotonic count of slow captures
+        self._slow_seen = 0  # _slow_seq at the last slow() read
 
     # -- recording --------------------------------------------------------
 
@@ -260,6 +391,11 @@ class Tracer:
         with self._lock:
             self._seq += 1
             sp.seq = self._seq
+            if (
+                len(self._spans) == self._spans.maxlen
+                and self._spans[0].seq > self._drained_to
+            ):
+                self._ring_dropped += 1
             self._spans.append(sp)
         if sp.parent_id is None and sp.duration >= self.slow_threshold:
             self._capture_slow(sp)
@@ -289,6 +425,12 @@ class Tracer:
                 "attrs"
             ]["peer"]
         with self._lock:
+            if len(self._slow) == self._slow.maxlen:
+                # oldest retained entry is capture #(_slow_seq-maxlen+1)
+                evicted = self._slow_seq - self._slow.maxlen + 1
+                if evicted > self._slow_seen:
+                    self._slow_dropped += 1
+            self._slow_seq += 1
             self._slow.append(entry)
         # One grep-able JSON line per slow request: the root, its
         # duration, and a per-span breakdown compact enough for logs.
@@ -343,15 +485,30 @@ class Tracer:
             if since > seq:
                 since = 0
             fresh = [s for s in self._spans if s.seq > since]
+            # This reader was offered everything up to seq (overwritten
+            # spans are reported via "dropped" below): later evictions
+            # of these spans are not loss.
+            self._drained_to = max(self._drained_to, seq)
+            ring_dropped = self._ring_dropped
+            slow_dropped = self._slow_dropped
         # Serialize OUTSIDE the lock (same discipline as percentile/
         # snapshot in metrics.py): a near-full-ring drain would
         # otherwise stall every concurrent record() — a span is
         # immutable once recorded, so the reference snapshot suffices.
         out = [s.to_dict() for s in fresh]
         oldest = fresh[0].seq if fresh else seq + 1
+        # Gauges refresh on every drain (the record hot path never pays
+        # a metrics lock): each collector scrape — and any /trace hit —
+        # keeps /metrics at most one drain stale.
+        from bftkv_tpu.metrics import registry as _metrics
+
+        _metrics.gauge("trace.ring.dropped", ring_dropped)
+        _metrics.gauge("trace.slow.dropped", slow_dropped)
         return {
             "cursor": seq,
             "dropped": max(0, oldest - since - 1),
+            "ring_dropped": ring_dropped,
+            "slow_dropped": slow_dropped,
             "spans": out,
         }
 
@@ -396,6 +553,7 @@ class Tracer:
 
     def slow(self) -> list[dict]:
         with self._lock:
+            self._slow_seen = self._slow_seq
             return list(self._slow)
 
     def reset(self) -> None:
@@ -403,6 +561,11 @@ class Tracer:
             self._spans.clear()
             self._slow.clear()
             self._seq = 0  # export() resyncs stale cursors from zero
+            self._ring_dropped = 0
+            self._slow_dropped = 0
+            self._drained_to = 0
+            self._slow_seq = 0
+            self._slow_seen = 0
 
 
 tracer = Tracer()

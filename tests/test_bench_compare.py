@@ -28,8 +28,8 @@ def detail_record(sections):
 def test_extracts_both_formats():
     d = extract_sections(driver_record({"cluster_4": ["cpu", 7.5],
                                         "rns_kernel": "skip"}))
-    assert d["cluster_4"] == ("cpu", 7.5, None, None)
-    assert d["rns_kernel"] == ("skip", None, None, None)
+    assert d["cluster_4"] == ("cpu", 7.5, None, None, None)
+    assert d["rns_kernel"] == ("skip", None, None, None, None)
     d = extract_sections(detail_record({
         "cluster_4": {"backend": "cpu", "writes_per_sec": 18.6,
                       "write_p50_s": 0.42},
@@ -37,24 +37,38 @@ def test_extracts_both_formats():
         "kernel": {"backend": "tpu", "rsa2048_verifies_per_sec": 5e5},
         "bad": {"error": "boom"},
     }))
-    assert d["cluster_4"] == ("cpu", 18.6, 0.42, None)
-    assert d["cluster_shards"] == ("cpu", 55.0, None, None)
+    assert d["cluster_4"] == ("cpu", 18.6, 0.42, None, None)
+    assert d["cluster_shards"] == ("cpu", 55.0, None, None, None)
     assert d["kernel"][1] == 5e5
-    assert d["bad"] == ("err", None, None, None)
+    assert d["bad"] == ("err", None, None, None, None)
     # three-element compact form (driver records after the round collapse)
     d = extract_sections(driver_record({"cluster_4": ["cpu", 7.5, 0.3]}))
-    assert d["cluster_4"] == ("cpu", 7.5, 0.3, None)
+    assert d["cluster_4"] == ("cpu", 7.5, 0.3, None, None)
     # four-element compact form: the gray section's slowdown ratio
     d = extract_sections(
         driver_record({"cluster_4_gray": ["cpu", 20.0, 0.1, 1.8]})
     )
-    assert d["cluster_4_gray"] == ("cpu", 20.0, 0.1, 1.8)
+    assert d["cluster_4_gray"] == ("cpu", 20.0, 0.1, 1.8, None)
     d = extract_sections(detail_record({
         "cluster_4_gray": {"backend": "cpu", "writes_per_sec": 20.0,
                            "write_p50_s": 0.1,
                            "gray_slowdown_hedged": 1.7},
     }))
-    assert d["cluster_4_gray"] == ("cpu", 20.0, 0.1, 1.7)
+    assert d["cluster_4_gray"] == ("cpu", 20.0, 0.1, 1.7, None)
+    # five-element compact form: phase_budget shares ride 5th (gray
+    # slot null when the section has no gray axis)
+    d = extract_sections(driver_record({
+        "cluster_4": ["cpu", 60.0, 0.2, None, {"rpc": 0.6, "server": 0.3}],
+    }))
+    assert d["cluster_4"] == (
+        "cpu", 60.0, 0.2, None, {"rpc": 0.6, "server": 0.3}
+    )
+    d = extract_sections(detail_record({
+        "cluster_4": {"backend": "cpu", "writes_per_sec": 60.0,
+                      "write_p50_s": 0.2,
+                      "phase_budget": {"rpc": 0.6}},
+    }))
+    assert d["cluster_4"][4] == {"rpc": 0.6}
 
 
 def test_gray_slowdown_gated():

@@ -27,6 +27,7 @@ def tree(tmp_path):
     (pkg / "protocol").mkdir(parents=True)
     shutil.copy(REPO / "bftkv_tpu" / "flags.py", pkg / "flags.py")
     shutil.copy(REPO / "bftkv_tpu" / "metrics.py", pkg / "metrics.py")
+    shutil.copy(REPO / "bftkv_tpu" / "trace.py", pkg / "trace.py")
     return tmp_path
 
 
@@ -248,6 +249,52 @@ def test_named_lock_seam_clean(tree):
         _lock = named_lock("protocol.fixture")
     """)
     assert fs == []
+
+
+# -- span-phase -------------------------------------------------------------
+
+
+def test_span_phase_undeclared_name_caught(tree):
+    fs = lint(tree, """\
+        from bftkv_tpu import trace
+
+        def f():
+            with trace.span("totally.new.span"):
+                pass
+    """)
+    assert rules_of(fs) == ["span-phase"]
+
+
+def test_span_phase_declared_forms_clean(tree):
+    fs = lint(tree, """\
+        from bftkv_tpu import trace
+
+        def f(name):
+            with trace.span("phase.write_sign"):      # exact
+                pass
+            with trace.span("rpc.anything_new"):      # prefix rule
+                pass
+            with trace.span(f"server.{name}"):        # f-string prefix
+                pass
+            with trace.span(name, phase="dispatch"):  # explicit phase
+                pass
+    """)
+    assert fs == []
+
+
+def test_span_phase_dynamic_without_phase_caught(tree):
+    fs = lint(tree, """\
+        from bftkv_tpu import trace
+
+        def f(self, name):
+            with trace.span(f"{self.name}.flush"):  # no leading literal
+                pass
+            with trace.span(name):                  # unresolvable
+                pass
+            with trace.span(name, phase="not-a-phase"):
+                pass
+    """)
+    assert [f.rule for f in fs] == ["span-phase"] * 3
 
 
 # -- waivers ----------------------------------------------------------------

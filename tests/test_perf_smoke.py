@@ -35,6 +35,13 @@ KEY_BITS = 1024  # keygen speed; the write path is bits-agnostic
 
 
 def test_write_path_throughput_floor():
+    # Mirror the daemon boot path (cmd/bftkv.py): with BFTKV_PROFILE
+    # set, the continuous sampler runs THROUGH the timed region below —
+    # CI's armed pass holds the same floors as the disarmed one, which
+    # is the profiler's within-5%-overhead contract.  Disarmed: no-op.
+    from bftkv_tpu.obs import profiler
+
+    profiler.ensure_started()
     cluster = start_cluster(
         4, WRITERS, 4, bits=KEY_BITS, storage_factory=MemStorage
     )

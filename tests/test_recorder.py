@@ -1,0 +1,162 @@
+"""Flight recorder (bftkv_tpu/obs/recorder): the anomaly→bundle path,
+window coalescing, the rate limit and disk caps, and the contract that
+a bundle opens with plain stdlib json and no live fleet."""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+from bftkv_tpu.obs import FleetCollector
+from bftkv_tpu.obs.recorder import FlightRecorder, read_manifest
+
+
+def _emit(coll, kind="member_down", source="a01", shard=0,
+          detail="probe failed"):
+    coll._emit(kind, source, shard, detail)
+
+
+# -- anomaly -> bundle ------------------------------------------------------
+
+
+def test_anomaly_mints_bundle_whose_manifest_names_it(tmp_path):
+    coll = FleetCollector([])
+    rec = FlightRecorder(
+        str(tmp_path / "bb"), min_interval_s=3600
+    ).add_to(coll)
+    assert coll.recorder is rec  # /fleet/bundle's demand seam
+    _emit(coll)
+    bundles = rec.bundles()
+    assert len(bundles) == 1 and rec.bundle_count == 1
+    man = read_manifest(bundles[0])
+    assert man["reason"] == "member_down"
+    assert [a["kind"] for a in man["anomalies"]] == ["member_down"]
+    assert man["anomalies"][0]["source"] == "a01"
+    # the manifest inventories every file with its true size, and each
+    # JSON feed parses with nothing but the stdlib — no live fleet, no
+    # bftkv import needed to open a black box
+    assert man["files"] and man["bytes"] == sum(man["files"].values())
+    for name, size in man["files"].items():
+        p = os.path.join(bundles[0], name)
+        assert os.path.getsize(p) == size
+        if name.endswith(".json"):
+            with open(p) as f:
+                json.load(f)
+    for expected in ("traces.json", "metrics.json", "anomalies.json",
+                     "failpoints.json"):
+        assert expected in man["files"]
+
+
+def test_same_window_anomalies_amend_not_mint(tmp_path):
+    coll = FleetCollector([])
+    rec = FlightRecorder(
+        str(tmp_path / "bb"), min_interval_s=3600
+    ).add_to(coll)
+    _emit(coll, "member_down")
+    _emit(coll, "gray_member", detail="a02 straggling")
+    assert len(rec.bundles()) == 1
+    assert rec.coalesced == 1
+    man = read_manifest(rec.bundles()[0])
+    assert [a["kind"] for a in man["anomalies"]] == [
+        "member_down", "gray_member",
+    ]
+    assert "amended_ts" in man
+
+
+def test_rate_limit_window_expiry_mints_fresh_bundle(tmp_path):
+    coll = FleetCollector([])
+    rec = FlightRecorder(
+        str(tmp_path / "bb"), min_interval_s=0.05
+    ).add_to(coll)
+    _emit(coll)
+    time.sleep(0.08)  # outside min_interval: a new event, a new box
+    _emit(coll, "slo_burn")
+    assert len(rec.bundles()) == 2
+    assert rec.coalesced == 0
+
+
+def test_mark_window_opens_fresh_epoch(tmp_path):
+    # The nemesis contract: back-to-back fault windows never share a
+    # bundle even when the rate limit would have coalesced them, and
+    # within one window every follow-up amends.
+    coll = FleetCollector([])
+    rec = FlightRecorder(
+        str(tmp_path / "bb"), min_interval_s=3600
+    ).add_to(coll)
+    rec.mark_window()
+    _emit(coll, "member_down")
+    _emit(coll, "member_down", source="a02")
+    rec.mark_window()
+    _emit(coll, "gray_member")
+    bundles = rec.bundles()
+    assert len(bundles) == 2
+    assert rec.coalesced == 1
+    kinds = [
+        [a["kind"] for a in read_manifest(b)["anomalies"]]
+        for b in bundles
+    ]
+    assert kinds == [["member_down", "member_down"], ["gray_member"]]
+
+
+# -- disk bounds ------------------------------------------------------------
+
+
+def test_bundle_count_cap_evicts_oldest(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "bb"), max_bundles=3)
+    for i in range(6):
+        rec.snapshot(reason=f"r{i}")
+        time.sleep(0.002)  # distinct millisecond stamps
+    bundles = rec.bundles()
+    assert len(bundles) == 3
+    # oldest evicted first; the black box keeps the recent past
+    assert [b.rsplit("-", 1)[1] for b in bundles] == ["r3", "r4", "r5"]
+    assert rec.bundle_count == 6  # created, not surviving
+
+
+def test_byte_cap_keeps_at_least_the_newest(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "bb"), max_bytes=1)
+    a = rec.snapshot(reason="first")
+    time.sleep(0.002)
+    b = rec.snapshot(reason="second")
+    # 1 byte fits nothing, but the just-written bundle must survive —
+    # an empty black box is worse than an oversized one
+    assert rec.bundles() == [b]
+    assert not os.path.isdir(a)
+
+
+def test_full_disk_suppressed_never_raises(tmp_path):
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a file where the bundle dir must go")
+    coll = FleetCollector([])
+    rec = FlightRecorder(str(blocked)).add_to(coll)
+    _emit(coll)  # must not raise out of the anomaly feed
+    assert rec.suppressed == 1 and rec.bundle_count == 0
+
+
+# -- demand snapshots with no live fleet ------------------------------------
+
+
+def test_demand_snapshot_with_nothing_wired(tmp_path):
+    # A recorder wired to no collector still writes a valid (sparse)
+    # bundle from the process-wide feeds — the cmd.fleet --bundle path
+    # against a dead fleet.
+    rec = FlightRecorder(str(tmp_path / "bb"))
+    bundle = rec.snapshot()
+    man = read_manifest(bundle)
+    assert man["reason"] == "demand"
+    assert man["anomalies"] == []
+    assert "traces.json" in man["files"]
+    assert "health.json" not in man["files"]  # no collector wired
+    with open(os.path.join(bundle, "metrics.json")) as f:
+        assert isinstance(json.load(f), dict)
+
+
+def test_reason_is_sanitized_into_the_dirname(tmp_path):
+    rec = FlightRecorder(str(tmp_path / "bb"))
+    bundle = rec.snapshot(reason="slo_burn: shard 0 / p99>0.5s!")
+    name = os.path.basename(bundle)
+    assert name.startswith("bundle-")
+    tail = name.split("-", 2)[2]
+    assert all(c.isalnum() or c in "-_" for c in tail)
+    assert os.path.isdir(bundle)

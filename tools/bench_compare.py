@@ -46,14 +46,13 @@ __all__ = [
     "REPORT_ONLY",
 ]
 
-#: Sections printed but never gated.  cluster_split rides REPORT_ONLY
-#: for its first landing (the cluster_4_gray precedent: no prior round
-#: to diff against, and its headline is a post-migration rate whose
-#: pre/post ratio is the real deliverable) — promote it to gated in a
-#: later round once a committed BENCH_r* carries it.  cluster_sidecar
-#: likewise first lands in BENCH_r09 (its deliverables are the
-#: self-relative occupancy>1 and shared-vs-baseline sign-p50 claims).
-REPORT_ONLY: set = {"cluster_split", "cluster_sidecar"}
+#: Sections printed but never gated.  Empty since cluster_split and
+#: cluster_sidecar were PROMOTED (second-landing precedent set by
+#: cluster_4_gray in PR 10): cluster_sidecar is committed in BENCH_r09
+#: and gates as soon as a newer round shares it; cluster_split gates
+#: from its first committed round onward.  A future first-landing
+#: section may ride here for ONE round, no longer.
+REPORT_ONLY: set = set()
 
 #: Absolute bound on the NEW record's hedged gray slowdown (write p50
 #: with one delayed clique member ÷ fault-free floor) — the DESIGN.md
@@ -69,9 +68,13 @@ def _backend_class(status: str) -> str:
 
 def extract_sections(doc: dict) -> dict:
     """``{section name: (status, headline number | None, p50 | None,
-    gray_slowdown | None)}`` — the fourth element only the gray
-    section carries (compact records: a 4th list element; detail
-    records: ``gray_slowdown_hedged``)."""
+    gray_slowdown | None, phase_budget | None)}`` — the fourth element
+    only the gray section carries (compact records: a 4th list
+    element; detail records: ``gray_slowdown_hedged``); the fifth is
+    the per-phase share dict the attribution plane emits (compact: 5th
+    element, null gray slot when the section has no gray axis; detail:
+    ``phase_budget``) — reported, never gated: shares shift with the
+    workload, the latency axes above are the gates."""
     sections = None
     for path in (("parsed", "extra", "sections"), ("extra", "sections"),
                  ("sections",)):
@@ -91,17 +94,19 @@ def extract_sections(doc: dict) -> dict:
         return v if isinstance(v, (int, float)) else None
 
     for name, sec in sections.items():
-        if isinstance(sec, (list, tuple)) and len(sec) in (2, 3, 4):
+        if isinstance(sec, (list, tuple)) and len(sec) in (2, 3, 4, 5):
             status = sec[0]
             p50 = num(sec[2]) if len(sec) >= 3 else None
             gray = num(sec[3]) if len(sec) >= 4 else None
-            out[name] = (str(status), num(sec[1]), p50, gray)
+            pb = sec[4] if len(sec) >= 5 and isinstance(sec[4], dict) \
+                else None
+            out[name] = (str(status), num(sec[1]), p50, gray, pb)
         elif isinstance(sec, dict):
             if "skipped" in sec:
-                out[name] = ("skip", None, None, None)
+                out[name] = ("skip", None, None, None, None)
                 continue
             if "error" in sec:
-                out[name] = ("err", None, None, None)
+                out[name] = ("err", None, None, None, None)
                 continue
             n = sec.get("writes_per_sec")
             if not isinstance(n, (int, float)):
@@ -114,14 +119,16 @@ def extract_sections(doc: dict) -> dict:
                     ),
                     None,
                 )
+            pb = sec.get("phase_budget")
             out[name] = (
                 str(sec.get("backend", "?")),
                 n,
                 num(sec.get("write_p50_s")),
                 num(sec.get("gray_slowdown_hedged")),
+                pb if isinstance(pb, dict) else None,
             )
         elif isinstance(sec, str):
-            out[name] = (sec, None, None, None)
+            out[name] = (sec, None, None, None, None)
     return out
 
 
@@ -143,7 +150,7 @@ def compare(
     for name in shared:
         if prefix and not name.startswith(prefix):
             continue
-        (sa, va, pa, _ga), (sb, vb, pb, gb) = a[name], b[name]
+        (sa, va, pa, _ga, _ba), (sb, vb, pb, gb, bb) = a[name], b[name]
         if name in REPORT_ONLY:
             lines.append(
                 f"  {name}: {va} -> {vb}  (report-only, not gated)"
@@ -181,6 +188,19 @@ def compare(
                 f"  {name} write p50: {pa:g}s -> {pb:g}s  "
                 f"({lratio:.2f}x)  {lverdict}"
             )
+        # Phase budget: the attribution plane's per-phase wall-clock
+        # shares — reported so the committed trajectory shows WHERE
+        # each round's latency went, never gated (shares shift with
+        # the workload; the latency axes above are the gates).
+        if isinstance(bb, dict) and bb:
+            shares = ", ".join(
+                f"{p}={v:.0%}"
+                for p, v in sorted(
+                    bb.items(), key=lambda kv: -kv[1]
+                )
+                if isinstance(v, (int, float)) and v >= 0.005
+            )
+            lines.append(f"  {name} phase budget: {shares}")
         # Gray axis: an ABSOLUTE bound on the new record, not a
         # round-over-round ratio — 2.1× vs 2.0× is a tiny relative
         # move but a broken acceptance bar (only the new side needs

@@ -41,6 +41,12 @@ which is how the planted-violation fixtures in tests/ are checked):
 - ``named-lock`` — ``threading.Lock()``/``RLock()`` construction in
   the package goes through ``devtools.lockwatch.named_lock`` so the
   lock sanitizer sees every lock.
+- ``span-phase`` — every ``trace.span(...)`` name must resolve to a
+  phase of the closed ``trace.PHASES`` enum via the ``SPAN_PHASES``
+  registry (exact name, declared ``name.`` prefix, or an explicit
+  ``phase=`` literal): an undeclared span silently lands in the
+  ``other`` budget bucket, which is exactly the unattributed latency
+  the critical-path plane exists to kill (DESIGN.md §18).
 """
 
 from __future__ import annotations
@@ -61,6 +67,7 @@ RULES = (
     "interned-error",
     "swallowed-exception",
     "named-lock",
+    "span-phase",
 )
 
 #: Layers whose error/exception discipline is wire-facing.
@@ -133,6 +140,37 @@ def declared_flags(root: str) -> set[str]:
         ):
             out.add(node.args[0].value)
     return out
+
+
+def declared_span_phases(root: str) -> tuple[set[str], dict[str, str]]:
+    """``(PHASES, SPAN_PHASES)`` from bftkv_tpu/trace.py — the closed
+    phase enum and the span-name registry (keys ending in ``.`` are
+    prefix rules), AST-parsed like every other registry here."""
+    path = os.path.join(root, "bftkv_tpu", "trace.py")
+    tree = ast.parse(open(path).read(), filename=path)
+    phases: set[str] = set()
+    span_phases: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.Assign, ast.AnnAssign)):
+            continue
+        targets = (
+            node.targets if isinstance(node, ast.Assign) else [node.target]
+        )
+        names = {t.id for t in targets if isinstance(t, ast.Name)}
+        value = node.value
+        if "PHASES" in names and isinstance(value, ast.Tuple):
+            phases = {
+                e.value for e in value.elts if isinstance(e, ast.Constant)
+            }
+        elif "SPAN_PHASES" in names and isinstance(value, ast.Dict):
+            for k, v in zip(value.keys, value.values):
+                if isinstance(k, ast.Constant) and isinstance(
+                    v, ast.Constant
+                ):
+                    span_phases[k.value] = v.value
+    if not phases or not span_phases:
+        raise RuntimeError("trace.PHASES / trace.SPAN_PHASES not found")
+    return phases, span_phases
 
 
 def declared_label_keys(root: str) -> set[str]:
@@ -233,12 +271,14 @@ class _FileLinter:
         rules: set[str],
         flags_declared: set[str],
         label_keys: set[str],
+        span_registry: tuple[set, dict] = (set(), {}),
     ):
         self.path = path
         self.rel = rel.replace(os.sep, "/")
         self.rules = rules
         self.flags_declared = flags_declared
         self.label_keys = label_keys
+        self.phases, self.span_phases = span_registry
         self.src = open(path).read()
         self.lines = self.src.split("\n")
         self.tree = ast.parse(self.src, filename=path)
@@ -567,6 +607,79 @@ class _FileLinter:
                     "lock sanitizer sees them",
                 )
 
+    # -- rule: span-phase --------------------------------------------------
+
+    def _span_name_declared(self, name: str) -> bool:
+        if name in self.span_phases:
+            return True
+        return any(
+            name.startswith(p)
+            for p in self.span_phases
+            if p.endswith(".")
+        )
+
+    def check_span_phase(self) -> None:
+        if not self.phases or self.rel.endswith("bftkv_tpu/trace.py"):
+            return
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            is_span = (
+                isinstance(f, ast.Attribute) and f.attr == "span"
+            ) or (isinstance(f, ast.Name) and f.id == "span")
+            if not is_span or not node.args:
+                continue
+            phase_kw = next(
+                (kw.value for kw in node.keywords if kw.arg == "phase"),
+                None,
+            )
+            if phase_kw is not None:
+                if not (
+                    isinstance(phase_kw, ast.Constant)
+                    and phase_kw.value in self.phases
+                ):
+                    self.emit(
+                        node, "span-phase",
+                        "phase= must be a string literal from "
+                        "trace.PHASES (closed enum)",
+                    )
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(
+                arg.value, str
+            ):
+                if not self._span_name_declared(arg.value):
+                    self.emit(
+                        node, "span-phase",
+                        f"span name {arg.value!r} resolves to no "
+                        "declared phase: add it (or a `prefix.` rule) "
+                        "to trace.SPAN_PHASES, or pass an explicit "
+                        "phase= — undeclared spans land in the 'other' "
+                        "budget bucket invisibly (DESIGN.md §18)",
+                    )
+            elif isinstance(arg, ast.JoinedStr):
+                lead = (
+                    arg.values[0].value
+                    if arg.values
+                    and isinstance(arg.values[0], ast.Constant)
+                    and isinstance(arg.values[0].value, str)
+                    else ""
+                )
+                if not lead or not self._span_name_declared(lead):
+                    self.emit(
+                        node, "span-phase",
+                        "dynamic span name with no declared-prefix "
+                        "leading literal: pass an explicit phase= from "
+                        "trace.PHASES",
+                    )
+            else:
+                self.emit(
+                    node, "span-phase",
+                    "span name is not statically resolvable: pass an "
+                    "explicit phase= from trace.PHASES",
+                )
+
     def run(self) -> list[Finding]:
         if "env-flag" in self.rules:
             self.check_env_flag()
@@ -580,6 +693,8 @@ class _FileLinter:
             self.check_swallowed_exception()
         if "named-lock" in self.rules:
             self.check_named_lock()
+        if "span-phase" in self.rules:
+            self.check_span_phase()
         return self.findings
 
 
@@ -648,14 +763,27 @@ def _walk_py(root: str, sub: str) -> list[str]:
     return sorted(out)
 
 
+def _span_registry(root: str) -> tuple[set, dict]:
+    """The span-phase registry, or empty when the target tree has no
+    trace.py (fixture trees): the rule then no-ops rather than failing
+    every unrelated lint."""
+    try:
+        return declared_span_phases(root)
+    except (OSError, RuntimeError):
+        return set(), {}
+
+
 def _lint_file(
-    p: str, rel: str, rules: set, flags_declared: set, label_keys: set
+    p: str, rel: str, rules: set, flags_declared: set, label_keys: set,
+    span_registry: tuple = (set(), {}),
 ) -> list[Finding]:
     """One file's findings; an unreadable or unparsable file is itself
     a finding (``parse-error``), never a traceback — the linter must
     survive hostile input like everything else in this tree."""
     try:
-        return _FileLinter(p, rel, rules, flags_declared, label_keys).run()
+        return _FileLinter(
+            p, rel, rules, flags_declared, label_keys, span_registry
+        ).run()
     except SyntaxError as e:
         return [
             Finding(
@@ -678,11 +806,14 @@ def lint_paths(
     rules = rules or set(RULES)
     flags_declared = declared_flags(root)
     label_keys = declared_label_keys(root)
+    span_registry = _span_registry(root)
     findings: list[Finding] = []
     for p in paths:
         rel = os.path.relpath(p, root) if os.path.isabs(p) else p
         findings.extend(
-            _lint_file(p, rel, rules, flags_declared, label_keys)
+            _lint_file(
+                p, rel, rules, flags_declared, label_keys, span_registry
+            )
         )
     return findings
 
@@ -692,12 +823,15 @@ def lint_repo(root: str) -> list[Finding]:
     plus the README freshness check."""
     flags_declared = declared_flags(root)
     label_keys = declared_label_keys(root)
+    span_registry = _span_registry(root)
     findings: list[Finding] = []
     rules = set(RULES)
     for p in _walk_py(root, "bftkv_tpu") + _walk_py(root, "tools"):
         rel = os.path.relpath(p, root)
         findings.extend(
-            _lint_file(p, rel, rules, flags_declared, label_keys)
+            _lint_file(
+                p, rel, rules, flags_declared, label_keys, span_registry
+            )
         )
     findings.extend(check_readme(root))
     return findings
