@@ -702,6 +702,19 @@ def bench_cluster(
             path = os.path.join(tmp.name, f"db{counter[0]}")
             return PlainStorage(path)
 
+    elif storage == "log":
+        from bftkv_tpu.storage.logkv import LogStorage
+
+        tmp = tempfile.TemporaryDirectory(prefix="bftkv-bench-")
+        counter = [0]
+
+        def storage_factory():
+            counter[0] += 1
+            path = os.path.join(tmp.name, f"db{counter[0]}")
+            # The daemon's durable default: every commit hits an fsync
+            # barrier (group-committed across concurrent writers).
+            return LogStorage(path)
+
     else:
         from bftkv_tpu.storage.memkv import MemStorage
 
@@ -889,8 +902,82 @@ def bench_cluster(
         dispatch.uninstall_all()
         for s in servers:
             s.tr.stop()
+            closer = getattr(s.storage, "close", None)
+            if closer is not None:
+                closer()
         if tmp is not None:
             tmp.cleanup()
+
+
+def _fill_sweep(cap: int) -> dict:
+    """Raw-engine fill scaling: write p50 (µs) at 10k/100k/1M resident
+    keys (points above ``cap`` skipped), log engine vs the plain-file
+    control, both with fsync off so the numbers isolate index+append
+    cost from disk flush latency.  The acceptance bound rides the log
+    row: p50 at the largest point within 1.3x of the 10k point."""
+    import statistics
+    import tempfile
+
+    from bftkv_tpu.storage.logkv import LogStorage
+    from bftkv_tpu.storage.plain import PlainStorage
+
+    points = [p for p in (10_000, 100_000, 1_000_000) if p <= cap]
+    if not points:
+        points = [cap]
+    payload = b"p" * 64
+    out: dict = {"keyspace_points": points}
+    for engine in ("log", "plain"):
+        row = {}
+        with tempfile.TemporaryDirectory(prefix="bftkv-fill-") as d:
+            filled = 0
+            if engine == "log":
+                s = LogStorage(os.path.join(d, "db"), fsync=False)
+            else:
+                s = PlainStorage(os.path.join(d, "db"), fsync=False)
+            for n in points:
+                while filled < n:
+                    s.write(b"fill-%09d" % filled, 1, payload)
+                    filled += 1
+                lat = []
+                for i in range(2000):
+                    t0 = time.perf_counter()
+                    s.write(b"probe-%d-%09d" % (n, i), 1, payload)
+                    lat.append(time.perf_counter() - t0)
+                row["p50_us_at_%d" % n] = round(
+                    statistics.median(lat) * 1e6, 2
+                )
+            closer = getattr(s, "close", None)
+            if closer is not None:
+                closer()
+        out[engine] = row
+    log_row = out["log"]
+    first, last = points[0], points[-1]
+    if last > first:
+        out["log_p50_ratio_%dx" % (last // first)] = round(
+            log_row["p50_us_at_%d" % last]
+            / max(log_row["p50_us_at_%d" % first], 1e-9),
+            3,
+        )
+    return out
+
+
+def bench_cluster_log(
+    writers: int,
+    writes_per_writer: int,
+    *,
+    keyspace: int,
+    zipf: float = 0.0,
+    open_loop: float = 0.0,
+) -> dict:
+    """The §19 log engine under the cluster_4 fleet (durable default:
+    group-committed fsync per commit) plus the raw-engine keyspace
+    fill sweep the issue's O(changed)/flat-p50 claims are judged on."""
+    res = bench_cluster(
+        4, 4, writers, writes_per_writer, storage="log",
+        dispatch_batch=256, zipf=zipf, open_loop=open_loop,
+    )
+    res["fill_sweep"] = _fill_sweep(keyspace)
+    return res
 
 
 def bench_cluster_gray(
@@ -2243,6 +2330,7 @@ SECTION_NAMES = {
     "csplit": "cluster_split",
     "csc": "cluster_sidecar",
     "c4gray": "cluster_4_gray",
+    "c4log": "cluster_4_log",
     "cgw": "cluster_gateway",
     "thr": "threshold_5_9",
     "tally": "revoke_tally_256",
@@ -2256,7 +2344,8 @@ SECTION_NAMES = {
 # likewise self-relative.
 # cluster_sidecar is shared-vs-per-process on the same box, also
 # self-relative.
-CPU_OK = {"tally", "c4", "cshards", "csplit", "c4gray", "cgw", "csc"}
+CPU_OK = {"tally", "c4", "cshards", "csplit", "c4gray", "cgw", "csc",
+          "c4log"}
 
 # Per-section subprocess timeouts (seconds).  The flapping tunnel makes
 # a hung section indistinguishable from a slow one until the timeout
@@ -2268,7 +2357,7 @@ TOKEN_TIMEOUT = {
     "kernel": 600, "modexp": 600, "tally": 600,
     "rns": 900, "sign": 900, "ec": 900, "thr": 900,
     "c4": 900, "c4http": 900, "c4ec": 900, "c16": 900, "c4gray": 900,
-    "cgw": 900,
+    "c4log": 900, "cgw": 900,
     "b16": 1200, "b64": 1500, "bmix64": 1500, "bmix64ec": 1500,
     "c64": 1500, "mix64": 1500, "cshards": 1500, "csplit": 900,
     "csc": 900,
@@ -2366,6 +2455,20 @@ def _section_spec(token: str):
         "c4gray": lambda: bench_cluster_gray(
             writers=4 if FAST else 8,
             writes_per_writer=4 if FAST else 10,
+        ),
+        # Log-structured engine (DESIGN.md §19): cluster_4 fleet on
+        # --storage log (group-committed durable writes) + the raw
+        # keyspace fill sweep (write p50 at 10k/100k/1M resident keys;
+        # --keyspace / BENCH_KEYSPACE caps the sweep).
+        "c4log": lambda: bench_cluster_log(
+            writers=4 if FAST else 8,
+            writes_per_writer=4 if FAST else 10,
+            keyspace=int(
+                os.environ.get("BENCH_KEYSPACE", "")
+                or ("100000" if FAST else "1000000")
+            ),
+            zipf=zipf,
+            open_loop=open_loop,
         ),
         # Edge gateway tier (ROADMAP item 1): N stacked gateways in
         # front of the quorums — certified-cache read throughput vs
@@ -2532,7 +2635,8 @@ def main() -> None:
 
     if FAST:
         default_configs = (
-            "rns,sign,b16,kernel,modexp,ec,c4,c16,cshards,c4gray,cgw,csc,tally"
+            "rns,sign,b16,kernel,modexp,ec,c4,c16,cshards,c4gray,c4log,"
+            "cgw,csc,tally"
         )
     else:
         # Short kernel sections FIRST: the tunnel flaps and its live
@@ -2543,7 +2647,7 @@ def main() -> None:
         # BENCH_partial.json keeps whatever landed.
         default_configs = (
             "rns,sign,kernel,ec,modexp,b16,b64,bmix64,bmix64ec,"
-            "c4,c16,c64,c4http,c4ec,cshards,c4gray,cgw,csc,thr,tally"
+            "c4,c16,c64,c4http,c4ec,cshards,c4gray,c4log,cgw,csc,thr,tally"
         )
     configs = [t for t in _env_list("BENCH_CONFIGS", default_configs)
                if t in SECTION_NAMES]
@@ -2827,6 +2931,13 @@ if __name__ == "__main__":
     if "--open-loop" in sys.argv:
         i = sys.argv.index("--open-loop")
         os.environ["BENCH_OPEN_LOOP"] = sys.argv[i + 1]
+        del sys.argv[i : i + 2]
+    # --keyspace N: cap for the cluster_4_log fill sweep (resident-key
+    # points 10k/100k/1M, skipping points above N), exported as
+    # BENCH_KEYSPACE so section subprocesses inherit it.
+    if "--keyspace" in sys.argv:
+        i = sys.argv.index("--keyspace")
+        os.environ["BENCH_KEYSPACE"] = sys.argv[i + 1]
         del sys.argv[i : i + 2]
     if len(sys.argv) >= 2 and sys.argv[1] == "--sidecar-tenant":
         _sidecar_tenant_main(sys.argv[2:])

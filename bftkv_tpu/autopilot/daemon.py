@@ -377,6 +377,63 @@ class Autopilot:
                     log.exception("autopilot: pre-copy sync round failed")
         return self._residual(moving, old_servers, new_servers)
 
+    def _snapshot_precopy(
+        self, moving: set[int], old_servers: list, new_servers: list
+    ) -> int:
+        """Sealed-segment bulk ship (DESIGN.md §19.5): when an old
+        owner's storage exposes ``snapshot_records``, stream its live
+        records for the moving buckets straight into the new owners'
+        admission path before any digest-round sync runs.  Sequential
+        segment reads on the sender, the FULL admission path on the
+        receiver (``admit_records`` parses, verifies, and gates every
+        record — a snapshot is a transport optimization, never a trust
+        shortcut).  Returns records shipped; 0 means the memory-backed
+        fallback (sync rounds) does all the copying."""
+        from bftkv_tpu.sync.daemon import MAX_PULL_RECORDS
+        from bftkv_tpu.sync.digest import HIDDEN_PREFIX
+
+        def pred(variable: bytes) -> bool:
+            if variable.startswith(HIDDEN_PREFIX):
+                return False
+            return route_bucket(variable) in moving
+
+        shipped = 0
+        for old in old_servers:
+            snap = getattr(old.storage, "snapshot_records", None)
+            if snap is None:
+                continue
+            chunk: list[bytes] = []
+            try:
+                for _variable, _t, value in snap(pred):
+                    chunk.append(value)
+                    if len(chunk) >= MAX_PULL_RECORDS:
+                        shipped += self._ship_chunk(chunk, new_servers)
+                        chunk = []
+                if chunk:
+                    shipped += self._ship_chunk(chunk, new_servers)
+            except Exception:
+                # Snapshot source failed mid-stream (compaction race,
+                # I/O fault): the digest-round sync below copies
+                # whatever didn't ship — correctness never depends on
+                # the fast path.
+                log.exception("autopilot: snapshot pre-copy failed")
+        if shipped:
+            metrics.incr("autopilot.snapshot_shipped", shipped)
+        return shipped
+
+    @staticmethod
+    def _ship_chunk(chunk: list[bytes], new_servers: list) -> int:
+        from bftkv_tpu.sync.daemon import admit_records
+
+        admitted = 0
+        for new in new_servers:
+            try:
+                got = admit_records(new, chunk)
+                admitted += got.get("admitted", 0)
+            except Exception:
+                log.exception("autopilot: snapshot admit failed")
+        return admitted
+
     def verify_handoff(
         self,
         moving: set[int],
@@ -407,10 +464,19 @@ class Autopilot:
         # plane's residue is repaired, so the split is the common case).
         owed: dict[bytes, int] = {}
         for old in old_servers:
+            # The digest tree's bucket index serves exactly the moving
+            # variables — O(moving), not O(keyspace).  Fall back to the
+            # full key listing only when the tree is unavailable.
             try:
-                keys = sorted(old.storage.keys())
+                tree = old._sync_tree()
+                keys = sorted(
+                    v for b in moving for v in tree.bucket_variables(b)
+                )
             except Exception:
-                continue
+                try:
+                    keys = sorted(old.storage.keys())
+                except Exception:
+                    continue
             for variable in keys:
                 if variable.startswith(HIDDEN_PREFIX):
                     continue
@@ -478,6 +544,13 @@ class Autopilot:
         strict = plan.kind == "retire"
         t_pre = time.monotonic()
         self.distribute(rt_stage, targets=new_servers)
+        # §19.5 fast path first: bulk-ship sealed-segment snapshots of
+        # the moving buckets through full admission, then let the
+        # digest rounds close whatever the snapshot missed (records
+        # appended after the seal, memory-backed old owners).
+        report["snapshot_shipped"] = self._snapshot_precopy(
+            moving, old_servers, new_servers
+        )
         residual = self._converge(moving, old_servers, new_servers)
         misses = self.verify_handoff(
             moving, old_servers, new_servers, strict=strict

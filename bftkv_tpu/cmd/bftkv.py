@@ -56,6 +56,13 @@ def build_server(args):
             args.db,
             fsync=flags.raw("BFTKV_PLAIN_FSYNC", "1") != "0",
         )
+    elif args.storage == "log":
+        from bftkv_tpu.storage.logkv import LogStorage
+
+        # Durable by default — the §19 engine's whole point is that
+        # the fsync is amortized across the group-commit batch, so
+        # there is no daemon/library durability split to opt into.
+        storage = LogStorage(args.db)
     elif args.storage == "native":
         from bftkv_tpu.storage.native import NativeStorage
 
@@ -374,8 +381,8 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description="bftkv server daemon")
     ap.add_argument("--home", required=True, help="home dir (pubring/secring)")
     ap.add_argument("--db", default="", help="storage path (dir or log file)")
-    ap.add_argument("--storage", choices=["plain", "native", "mem"],
-                    default="plain")
+    ap.add_argument("--storage", choices=["plain", "log", "native", "mem"],
+                    default=flags.get("BFTKV_STORAGE") or "plain")
     ap.add_argument("--api", default="", help="client API listen addr host:port")
     ap.add_argument("--client-home", default="",
                     help="home dir whose identity performs client-API "

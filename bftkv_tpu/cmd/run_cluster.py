@@ -22,6 +22,8 @@ import subprocess
 import sys
 import time
 
+from bftkv_tpu import flags
+
 
 def server_homes(keys_dir: str) -> list[str]:
     out = []
@@ -242,8 +244,8 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description="bftkv cluster runner")
     ap.add_argument("--keys", required=True, help="directory of home dirs")
     ap.add_argument("--db-root", required=True)
-    ap.add_argument("--storage", choices=["plain", "native", "mem"],
-                    default="plain")
+    ap.add_argument("--storage", choices=["plain", "log", "native", "mem"],
+                    default=flags.get("BFTKV_STORAGE") or "plain")
     ap.add_argument("--api-base", type=int, default=0,
                     help="client API port for the first server, +1 each")
     ap.add_argument("--client-home", default="",

@@ -155,6 +155,25 @@ class RecordingStorage:
 
     def write(self, variable: bytes, t: int, value: bytes) -> None:
         self.inner.write(variable, t, value)
+        self._record_persist(variable, t, value)
+
+    def write_batch(self, items) -> None:
+        """Group-commit seam passthrough: the batch persists through
+        the inner engine's one-barrier path when it has one (per-item
+        writes otherwise), and EVERY item is recorded — the checker's
+        commit-point evidence must not thin out because the persists
+        were coalesced."""
+        items = list(items)
+        wb = getattr(self.inner, "write_batch", None)
+        if wb is not None:
+            wb(items)
+        else:
+            for variable, t, value in items:
+                self.inner.write(variable, t, value)
+        for variable, t, value in items:
+            self._record_persist(variable, t, value)
+
+    def _record_persist(self, variable: bytes, t: int, value: bytes) -> None:
         if variable.startswith(HIDDEN_PREFIX):
             return  # threshold-CA shares: not protocol records
         completed = False
@@ -174,6 +193,12 @@ class RecordingStorage:
             value=pvalue,
             completed=completed,
         )
+
+    def __getattr__(self, name: str):
+        # Optional-seam passthrough (sorted_keys / snapshot_records /
+        # reopen / close / ...): capability detection on the wrapper
+        # must reflect the inner engine's true surface.
+        return getattr(self.inner, name)
 
     # MalStorage pass-through so byzantine programs keep their side area.
     def mal_write(self, variable: bytes, t: int, value: bytes) -> None:

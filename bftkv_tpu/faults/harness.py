@@ -121,6 +121,13 @@ class ChaosCluster:
         the crash-restart the anti-entropy plane must repair."""
         old = self._by_name[name]
         old.tr.stop()  # idempotent when already crashed
+        # Disk-backed engines (§19 log): a real restart re-opens the
+        # data dir — drop the in-RAM index, rebuild from the segment
+        # scan, truncate any torn tail.  The RecordingStorage wrapper
+        # passes reopen() through; memory backends have none.
+        reopen = getattr(old.storage, "reopen", None)
+        if reopen is not None:
+            reopen()
         ident = self._idents[name]
         graph, crypt, qs = topology.make_node(
             ident, self.universe.view_of(ident)

@@ -38,6 +38,7 @@ plan + fault-trace summary as JSON (``--json``) for the CI soak lane.
 
 from __future__ import annotations
 
+import os
 import random
 import threading
 import time
@@ -46,6 +47,7 @@ from bftkv_tpu import flags
 from bftkv_tpu.faults import byzantine, failpoint as fp
 from bftkv_tpu.faults.checker import SafetyChecker
 from bftkv_tpu.faults.harness import ChaosCluster, build_cluster
+from bftkv_tpu.storage.memkv import MemStorage
 
 __all__ = ["Nemesis", "main"]
 
@@ -1180,6 +1182,12 @@ def main(argv: list[str] | None = None) -> int:
                          "chaos), crash-restarted replicas are "
                          "re-delivered the current route table, and "
                          "the route_flap kind becomes available")
+    ap.add_argument("--storage", choices=["mem", "log"], default="mem",
+                    help="replica storage engine: `log` gives every "
+                         "replica its own on-disk §19 segment-log "
+                         "directory, so crash_restart re-opens the "
+                         "SAME log dir (index rebuild + torn-tail "
+                         "truncation under chaos)")
     ap.add_argument("--sidecar", action="store_true",
                     help="route the whole cluster's verify+sign through "
                          "an embedded shared crypto sidecar and add the "
@@ -1206,9 +1214,29 @@ def main(argv: list[str] | None = None) -> int:
     # the cluster boots: every server's share issuance and collective
     # verify then routes through the service under test.
     sidecar_ctl = SidecarHarness() if args.sidecar else None
+    storage_factory = MemStorage
+    log_root = None
+    if args.storage == "log":
+        import tempfile
+
+        from bftkv_tpu.storage.logkv import LogStorage
+
+        log_root = tempfile.TemporaryDirectory(prefix="bftkv-nemesis-log-")
+        counter = iter(range(10_000))
+
+        def storage_factory(root=log_root.name):
+            # One log dir per replica; crash_restart re-opens the same
+            # dir via the harness's reopen() hook.  fsync stays ON —
+            # the soak exercises the real durability path; the tiny
+            # segment size forces seals + compaction within the run.
+            return LogStorage(
+                os.path.join(root, f"replica-{next(counter):03d}"),
+                segment_bytes=256 * 1024,
+            )
+
     cluster = build_cluster(
         args.servers, 1, args.rw, bits=args.bits, n_shards=args.shards,
-        n_gateways=args.gateways,
+        n_gateways=args.gateways, storage_factory=storage_factory,
     )
     try:
         report = Nemesis(
@@ -1222,6 +1250,12 @@ def main(argv: list[str] | None = None) -> int:
         cluster.stop()
         if sidecar_ctl is not None:
             sidecar_ctl.stop()
+        if log_root is not None:
+            for srv in cluster.all_servers:
+                close = getattr(srv.storage, "close", None)
+                if close is not None:
+                    close()
+            log_root.cleanup()
     # Lock-order chaos soak (DESIGN.md §16): with BFTKV_LOCKWATCH=1 the
     # whole schedule ran under the runtime lock sanitizer — any cycle in
     # the acquisition-order graph or blocking call under a watched lock

@@ -238,6 +238,19 @@ _flag("BFTKV_PLAIN_FSYNC", None, "switch",
       "policy).")
 _flag("BFTKV_PLAIN_CACHE", "1024", "int",
       "PlainStorage write-through record cache (entries; 0 disables).")
+_flag("BFTKV_STORAGE", None, "str",
+      "Default `--storage` engine for the daemon/cluster CLIs "
+      "(plain|log|native|mem; unset: plain).")
+_flag("BFTKV_LOG_SEGMENT_MB", "64", "int",
+      "LogStorage segment size: the active segment seals past this "
+      "and becomes a shippable snapshot unit (DESIGN.md §19).")
+_flag("BFTKV_LOG_GROUP_COMMIT_MS", "2", "float",
+      "LogStorage group-commit linger: how long the fsync leader "
+      "waits for concurrent writers to join its barrier (0 = fsync "
+      "immediately, still shared by the losers of the leader race).")
+_flag("BFTKV_LOG_COMPACT_TRIGGER", "0.5", "float",
+      "LogStorage background compaction trigger: sealed dead-byte "
+      "ratio past which a compaction pass starts (0 disables).")
 
 _begin("Observability & tooling")
 _flag("BFTKV_TRACE", "on", "switch",

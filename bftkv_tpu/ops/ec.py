@@ -297,6 +297,9 @@ def scalar_mult_hosts(points: list, scalars: list[int]) -> list:
     """
     if not points:
         return []
+    from bftkv_tpu import ops
+
+    ops.enable_compile_cache()
     if _use_rns_backend():
         try:
             from bftkv_tpu.ops import ec_rns

@@ -475,6 +475,9 @@ def scalar_mult_hosts(points: list, scalars: list[int]) -> list:
     floor 8)."""
     if not points:
         return []
+    from bftkv_tpu import ops
+
+    ops.enable_compile_cache()
     eng = _engine()
     t = len(points)
     padded = max(8, 1 << (t - 1).bit_length())

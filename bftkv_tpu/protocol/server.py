@@ -105,6 +105,32 @@ class Server(Protocol):
         if tree is not None:
             tree.mark(variable)
 
+    def _persist_many(self, entries) -> None:
+        """Batch form of :meth:`_persist` — the group-commit seam.  A
+        backend exposing ``write_batch`` (the §19 log engine) persists
+        the whole coalesced batch under ONE durability barrier; every
+        other backend falls back to per-item writes, so callers
+        (BATCH_WRITE, ``admit_records``, the sync back-fill) can batch
+        unconditionally."""
+        entries = list(entries)
+        if not entries:
+            return
+        wb = getattr(self.storage, "write_batch", None)
+        if wb is not None and len(entries) > 1:
+            nbytes = sum(len(d) for _v, _t, d in entries)
+            with trace.span(
+                "storage.write",
+                attrs={"bytes": nbytes, "batch": len(entries)},
+            ):
+                wb(entries)
+            tree = self._sync
+            if tree is not None:
+                for variable, _t, _d in entries:
+                    tree.mark(variable)
+            return
+        for variable, t, data in entries:
+            self._persist(variable, t, data)
+
     def _sync_tree(self):
         with self._sync_lock:
             if self._sync is None:
@@ -139,16 +165,30 @@ class Server(Protocol):
         (``ss is None`` — the read path's scan-back + certify-on-read
         already owns that shape), and anything unparsable."""
         out: list[tuple[bytes, int, bytes, object]] = []
-        try:
-            keys = sorted(self.storage.keys())
-        except Exception:
-            return out, None
-        if after is not None:
-            keys = [k for k in keys if k > after]
         cursor = None
-        if scan_window is not None and len(keys) > scan_window:
-            keys = keys[:scan_window]
-            cursor = keys[-1]  # more keys remain past this window
+        sk = getattr(self.storage, "sorted_keys", None)
+        if sk is not None and scan_window is not None:
+            # Storage-served cursor (§19 log engine): one bisect +
+            # slice instead of re-sorting the whole keyspace every
+            # repair round.  Ask for one extra key to learn whether
+            # the window exhausted the keyspace.
+            try:
+                keys = sk(after=after, limit=scan_window + 1)
+            except Exception:
+                return out, None
+            if len(keys) > scan_window:
+                keys = keys[:scan_window]
+                cursor = keys[-1]  # more keys remain past this window
+        else:
+            try:
+                keys = sorted(self.storage.keys())
+            except Exception:
+                return out, None
+            if after is not None:
+                keys = [k for k in keys if k > after]
+            if scan_window is not None and len(keys) > scan_window:
+                keys = keys[:scan_window]
+                cursor = keys[-1]  # more keys remain past this window
         for variable in keys:
             if len(out) >= limit:
                 break
@@ -1632,6 +1672,9 @@ class Server(Protocol):
                     results[i] = (_errstr(verrs[j]), b"")
                     parsed[i] = None
 
+        persists: list[tuple[bytes, int, bytes]] = []
+        ok_idx: list[int] = []
+        seen_vars: set[bytes] = set()
         for i in range(n):
             if parsed[i] is None:
                 continue
@@ -1643,6 +1686,14 @@ class Server(Protocol):
                 p.sig,
                 p.ss,
             )
+            if variable in seen_vars and persists:
+                # A frame naming one variable twice: the second item's
+                # admission gates (monotonicity, equivocation) must see
+                # the first item's stored state — flush the deferred
+                # batch before checking it.
+                self._persist_many(persists)
+                persists = []
+            seen_vars.add(variable)
             try:
                 out = self._write_storage_checks(
                     variable, val, t, sig, ss, r, frame_embedded
@@ -1651,7 +1702,12 @@ class Server(Protocol):
                 results[i] = (_errstr(e), b"")
                 continue
             if out is not None:  # None = idempotent no-op (see checks)
-                self._persist(variable, t, out)
+                persists.append((variable, t, out))
+            ok_idx.append(i)
+        # One durability barrier for the whole admitted frame — the
+        # group-commit seam the gateway write coalescer feeds.
+        self._persist_many(persists)
+        for i in ok_idx:
             metrics.incr("server.write.ok")
             results[i] = (None, b"")
 

@@ -14,7 +14,25 @@ Backends:
   used by tests and simulated clusters;
 - :class:`bftkv_tpu.storage.native.NativeStorage` — C++ log-structured
   engine (the leveldb-equivalent, reference: storage/leveldb/leveldb.go),
-  loaded via ctypes when the shared library has been built.
+  loaded via ctypes when the shared library has been built;
+- :class:`bftkv_tpu.storage.logkv.LogStorage` — append-only group-commit
+  segment log with compaction and snapshot shipping (DESIGN.md §19),
+  the planet-scale engine (`--storage log`).
+
+Optional seams (feature-detected with ``getattr``, never required —
+the Protocol below stays the contract every backend must meet):
+
+- ``write_batch(items)`` — persist a coalesced batch under ONE
+  durability barrier (group commit).  The server's persist-many path
+  and ``admit_records`` use it when present and fall back to per-item
+  ``write`` when not;
+- ``sorted_keys(after=None, limit=None)`` — a cheap sorted-keyspace
+  cursor for the windowed ``pending_variables`` repair scan, replacing
+  a full ``sorted(keys())`` per round;
+- ``snapshot_records(pred)`` / ``seal_active()`` — sealed-segment bulk
+  streaming, the §15 migration pre-copy transfer unit;
+- ``reopen()`` / ``close()`` — crash-restart onto the same directory
+  (index rebuild, torn-tail truncation) and clean shutdown.
 """
 
 from __future__ import annotations

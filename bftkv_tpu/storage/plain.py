@@ -14,6 +14,7 @@ same way the reference serializes file I/O with a mutex
 
 from __future__ import annotations
 
+import bisect
 import os
 import threading
 from collections import OrderedDict
@@ -50,6 +51,11 @@ class PlainStorage:
         # contract (the reference serializes it behind one mutex too),
         # so no other writer can stale it.
         self._latest: dict[str, int] | None = None
+        # stem -> sorted stored ts, rebuilt by the same one-time listing
+        # and maintained by ``write``.  ``versions()`` used to list the
+        # whole directory per call — profiled hot in repair scans,
+        # where every pending variable asks for its version set.
+        self._versions: dict[str, list[int]] | None = None
         # Write-through record cache (the block-cache any storage
         # engine keeps): the protocol re-reads a variable's latest
         # record at every admission station, and on slow filesystems
@@ -83,6 +89,7 @@ class PlainStorage:
         idx = self._latest
         if idx is None:
             idx = {}
+            vers: dict[str, list[int]] = {}
             try:
                 with lockwatch.waiver(
                     "plain: one-time index rebuild must hold the store "
@@ -101,6 +108,10 @@ class PlainStorage:
                     continue  # .tmp / .k sidecars
                 if t > idx.get(stem, -1):
                     idx[stem] = t
+                vers.setdefault(stem, []).append(t)
+            for ts in vers.values():
+                ts.sort()
+            self._versions = vers
             self._latest = idx
         return idx
 
@@ -196,29 +207,27 @@ class PlainStorage:
         with self._lock:
             if self._latest is not None and t > self._latest.get(stem, -1):
                 self._latest[stem] = t
+            if self._versions is not None:
+                ts = self._versions.setdefault(stem, [])
+                i = bisect.bisect_left(ts, t)
+                if i == len(ts) or ts[i] != t:
+                    ts.insert(i, t)
             self._cache_put_locked(stem, t, value)
 
     def versions(self, variable: bytes) -> list[int]:
         """All stored timestamps for ``variable`` (ascending).
 
-        No lock: the listing reads only the directory, data files are
-        never deleted, and renames are atomic — the store lock never
-        serialized the renames anyway (``_write_atomic`` runs outside
-        it), so holding it here bought nothing but a stall for every
-        concurrent handler (lockwatch finding, DESIGN.md §16)."""
-        prefix = self._prefix(variable) + "."
-        out = []
-        try:
-            names = os.listdir(self.path)
-        except FileNotFoundError:
-            return out
-        for name in names:
-            if name.startswith(prefix) and not name.endswith(".tmp"):
-                try:
-                    out.append(int(name[len(prefix) :]))
-                except ValueError:
-                    continue
-        return sorted(out)
+        Served from the version index — this used to list the WHOLE
+        directory per call, and repair scans (which ask for every
+        pending variable's version set) profiled it hot.  The lock
+        covers only the index lookup; the one-time rebuild inside
+        ``_index_locked`` carries the listing cost exactly once per
+        process lifetime."""
+        stem = self._prefix(variable)
+        with self._lock:
+            self._index_locked()
+            vs = self._versions.get(stem) if self._versions else None
+            return list(vs) if vs else []
 
     def _inventory(self) -> dict[bytes, list[int]]:
         """variable → timestamps, decoded from the directory listing.

@@ -172,6 +172,8 @@ def admit_records(server, records: list[bytes]) -> dict:
         verrs = []
 
     vi = 0
+    persists: list[tuple[bytes, int, bytes]] = []
+    seen_vars: set[bytes] = set()
     for entry in parsed:
         if entry is None:
             continue
@@ -182,6 +184,13 @@ def admit_records(server, records: list[bytes]) -> dict:
             stats["rejected"] += 1
             continue
         variable = p.variable or b""
+        if variable in seen_vars and persists:
+            # One variable twice in a pull (hostile peers can): the
+            # second record's admission gates must see the first's
+            # stored state — flush the deferred batch first.
+            server._persist_many(persists)
+            persists = []
+        seen_vars.add(variable)
         try:
             # Timestamp monotonicity, equivocation, and TOFU against the
             # locally stored record — the same checks ``_write`` runs.
@@ -191,8 +200,11 @@ def admit_records(server, records: list[bytes]) -> dict:
         except Exception:
             stats["rejected"] += 1
             continue
-        server._persist(variable, p.t, out)
+        persists.append((variable, p.t, out))
         stats["admitted"] += 1
+    # ONE durability barrier for the whole admitted pull — the §19
+    # group-commit seam (falls back to per-record writes elsewhere).
+    server._persist_many(persists)
 
     metrics.incr("sync.pull.records", stats["admitted"])
     metrics.incr("sync.rejected", stats["rejected"])

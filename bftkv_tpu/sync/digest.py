@@ -11,10 +11,14 @@ commutative, so bucket membership needs no ordering and a single
 record's change re-derives from the bucket's variables alone.
 
 Incrementality: the first build walks ``storage.keys()`` once; after
-that, every server-side persist marks the written variable's bucket
-dirty and the next digest request recomputes only dirty buckets.  The
-tree never caches record bytes — storage stays the single source of
-truth, so a crash/restart simply rebuilds.
+that, every server-side persist marks the written VARIABLE dirty and
+the next digest request re-reads only the dirty variables — each
+bucket hash is patched by XOR-ing the variable's cached old
+contribution out and its fresh one in, so a digest round after N
+changed records costs O(N) storage reads regardless of keyspace size
+(the §19 log engine's bound; it holds for every backend).  The tree
+caches one integer per variable, never record bytes — storage stays
+the single source of truth, so a crash/restart simply rebuilds.
 
 Two replicas with equal trees serve identical completed state; a
 divergent bucket names the (at most 1/256th) slice of the keyspace to
@@ -107,30 +111,35 @@ def latest_completed(
 
 
 class DigestTree:
-    """Per-storage digest with dirty-bucket invalidation."""
+    """Per-storage digest with dirty-VARIABLE invalidation: a digest
+    round costs O(records changed since the last round), not O(dirty
+    buckets × bucket population)."""
 
     def __init__(self, storage):
         self.storage = storage
         self._lock = named_lock("sync.digest")
         self._vars: dict[int, set[bytes]] = {}
-        self._hashes: dict[int, bytes] = {}
-        self._dirty: set[int] = set()
+        #: variable -> its current XOR contribution to its bucket (as
+        #: an int; 0 = contributes nothing).  The cache that buys
+        #: O(changed): patching a bucket is old-out/new-in, no walk.
+        self._contrib: dict[bytes, int] = {}
+        self._hash_int: dict[int, int] = {}
+        self._dirty: dict[int, set[bytes]] = {}
         self._built = False
 
     # -- write-path hook ---------------------------------------------------
 
     def mark(self, variable: bytes) -> None:
-        """Invalidate the written variable's bucket (cheap dict ops
-        only; called from every server persist).  Recording even
-        before the first build means a write landing DURING the build's
-        keyspace scan cannot be lost — the merge in
-        :meth:`_ensure_built` keeps it."""
+        """Invalidate the written variable (cheap dict ops only; called
+        from every server persist).  Recording even before the first
+        build means a write landing DURING the build's keyspace scan
+        cannot be lost — the merge in :meth:`_ensure_built` keeps it."""
         if variable.startswith(HIDDEN_PREFIX):
             return
         b = bucket_of(variable)
         with self._lock:
             self._vars.setdefault(b, set()).add(variable)
-            self._dirty.add(b)
+            self._dirty.setdefault(b, set()).add(variable)
 
     # -- digest ------------------------------------------------------------
 
@@ -148,43 +157,54 @@ class DigestTree:
             for var in keys:
                 if var.startswith(HIDDEN_PREFIX):
                     continue
-                self._vars.setdefault(bucket_of(var), set()).add(var)
-            self._dirty = set(self._vars)
+                b = bucket_of(var)
+                self._vars.setdefault(b, set()).add(var)
+                self._dirty.setdefault(b, set()).add(var)
             self._built = True
 
     def buckets(self) -> dict[int, bytes]:
-        """Non-empty bucket hashes, recomputing only dirty buckets.
+        """Non-empty bucket hashes, re-reading only DIRTY variables.
 
         The per-record storage reads happen OUTSIDE the tree lock:
         ``mark()`` sits on every server persist, so holding the lock
-        through a keyspace scan would stall the foreground write path
-        behind a background digest request.  A bucket marked dirty
-        again mid-recompute simply stays dirty and refreshes on the
-        next call — staleness is bounded by one round either way."""
+        through the reads would stall the foreground write path behind
+        a background digest request.  A variable marked dirty again
+        mid-recompute lands in the next round's dirty set and refreshes
+        then — staleness is bounded by one round either way."""
         self._ensure_built()
         with self._lock:
             dirty = self._dirty
-            self._dirty = set()
-            todo = {b: sorted(self._vars.get(b, ())) for b in dirty}
-        fresh: dict[int, bytes | None] = {}
-        for b, variables in todo.items():
-            acc = 0
-            for var in variables:
-                rec = latest_completed(self.storage, var)
-                if rec is None:
-                    continue
+            self._dirty = {}
+            todo = [
+                (b, var) for b, vs in dirty.items() for var in sorted(vs)
+            ]
+        fresh: list[tuple[int, bytes, int]] = []
+        for b, var in todo:
+            rec = latest_completed(self.storage, var)
+            if rec is None:
+                new = 0
+            else:
                 t, _raw, p = rec
-                acc ^= int.from_bytes(record_hash(var, t, p.value), "big")
-            fresh[b] = (
-                acc.to_bytes(pkt.DIGEST_HASH_LEN, "big") if acc else None
-            )
+                new = int.from_bytes(record_hash(var, t, p.value), "big")
+            fresh.append((b, var, new))
         with self._lock:
-            for b, h in fresh.items():
-                if h is None:
-                    self._hashes.pop(b, None)
+            for b, var, new in fresh:
+                old = self._contrib.get(var, 0)
+                if new == old:
+                    continue
+                acc = self._hash_int.get(b, 0) ^ old ^ new
+                if acc:
+                    self._hash_int[b] = acc
                 else:
-                    self._hashes[b] = h
-            return dict(self._hashes)
+                    self._hash_int.pop(b, None)
+                if new:
+                    self._contrib[var] = new
+                else:
+                    self._contrib.pop(var, None)
+            return {
+                b: acc.to_bytes(pkt.DIGEST_HASH_LEN, "big")
+                for b, acc in self._hash_int.items()
+            }
 
     def bucket_variables(self, b: int) -> list[bytes]:
         """Variables currently assigned to bucket ``b`` (serving side

@@ -229,6 +229,83 @@ def test_retire_spent_clique():
         cluster.stop()
 
 
+def test_split_snapshot_precopy_log_backed(tmp_path):
+    """DESIGN.md §19.5: a split over log-backed replicas bulk-ships
+    sealed-segment snapshots through the full admission path before
+    the converge loop, with zero failed writes during the migration
+    and the moved history readable from the new owners afterwards."""
+    import itertools
+    import os as _os
+
+    from bftkv_tpu.faults.harness import build_cluster
+    from bftkv_tpu.storage.logkv import LogStorage
+
+    counter = itertools.count()
+    root = str(tmp_path / "logs")
+
+    def factory():
+        return LogStorage(
+            _os.path.join(root, "replica-%03d" % next(counter)),
+            fsync=False,
+            segment_bytes=1 << 16,
+        )
+
+    cluster = build_cluster(
+        4, 1, 4, bits=1024, n_shards=2, storage_factory=factory
+    )
+    try:
+        cl = cluster.clients[0]
+        qs = cl.qs
+        keys = hot_keys_for(qs, 0, 16, tag=b"snap")
+        for k in keys:
+            cl.write(k, b"v-" + k)
+        cl.drain_tails()
+
+        ap = Autopilot.for_cluster(cluster)
+        owner = qs.effective_route()
+        shard0 = [b for b in range(ROUTE_BUCKETS) if owner[b] == 0]
+        assign = {b: 1 for b in shard0[: len(shard0) // 2]}
+
+        stop = threading.Event()
+        failures: list = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                try:
+                    cl.write(keys[i % len(keys)], b"w%d" % i)
+                except Exception as e:  # pragma: no cover - must not fire
+                    failures.append(e)
+
+        t = threading.Thread(target=writer, daemon=True)
+        t.start()
+        try:
+            report = ap.execute(Plan("split", 0, assign, reason="test"))
+        finally:
+            stop.set()
+            t.join(10)
+        cl.drain_tails()
+
+        assert report["ok"], report
+        # log-backed old owners expose snapshot_records(); the pre-copy
+        # stage must actually ship admitted records, not fall back to
+        # the per-variable converge loop alone
+        assert report.get("snapshot_shipped", 0) > 0, report
+        assert not failures, failures[:3]
+        for k in keys:
+            assert cl.read(k) is not None
+        moved = [k for k in keys if qs.shard_of(k) == 1]
+        assert moved, "no key rerouted by the split"
+        for k in moved[:4]:
+            cl.write(k, b"post-" + k)
+        cl.drain_tails()
+        for k in moved[:4]:
+            assert cl.read(k) == b"post-" + k
+    finally:
+        cluster.stop()
+
+
 def test_decide_retire_from_real_f_budget():
     """The full detect→decide loop for retirement: crash enough of one
     clique that the fleet collector's f-budget hits zero, and the

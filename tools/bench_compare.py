@@ -46,13 +46,12 @@ __all__ = [
     "REPORT_ONLY",
 ]
 
-#: Sections printed but never gated.  Empty since cluster_split and
-#: cluster_sidecar were PROMOTED (second-landing precedent set by
-#: cluster_4_gray in PR 10): cluster_sidecar is committed in BENCH_r09
-#: and gates as soon as a newer round shares it; cluster_split gates
-#: from its first committed round onward.  A future first-landing
-#: section may ride here for ONE round, no longer.
-REPORT_ONLY: set = set()
+#: Sections printed but never gated.  cluster_4_log rides here for its
+#: FIRST landing round (the cluster_4_gray / cluster_sidecar
+#: precedent): the §19 log engine's first committed numbers seed the
+#: trajectory, and the section gates as soon as a newer round shares
+#: it.  One round, no longer.
+REPORT_ONLY: set = {"cluster_4_log"}
 
 #: Absolute bound on the NEW record's hedged gray slowdown (write p50
 #: with one delayed clique member ÷ fault-free floor) — the DESIGN.md
