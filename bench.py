@@ -516,6 +516,30 @@ def _hot_loop_metrics(snap: dict) -> dict:
     }
 
 
+def _capacity_series(snap: dict, elapsed_s: float = 1.0) -> dict:
+    """USE capacity rows + the device-occupancy extract over a
+    section's final metrics snapshot (DESIGN.md §20).  compute_member
+    with an empty baseline reads counter deltas as section totals —
+    the honest single-window reading — so every committed round
+    carries where the box queued, not just how fast it went."""
+    from bftkv_tpu.obs.capacity import _index, compute_member
+
+    rows = compute_member(_index(snap), {}, max(elapsed_s, 1e-9))
+    cap = {
+        res: {
+            "utilization": round(row["utilization"], 4),
+            "saturation": round(row["saturation"], 4),
+            "errors": row["errors"],
+        }
+        for res, row in rows.items()
+    }
+    occ = {}
+    for name, d in (rows.get("dispatch", {}).get("dispatchers") or {}).items():
+        for w, o in sorted((d.get("device_occupancy") or {}).items()):
+            occ[f"{name}[{w}]"] = round(o, 4)
+    return {"capacity": cap, "device_occupancy": occ}
+
+
 def _round_breakdown(since_cursor: int) -> dict:
     """Per-round write-latency breakdown, derived from the tracer ring
     (the per-process half of the PR 7 stitched-trace plane): p50 of
@@ -895,6 +919,7 @@ def bench_cluster(
         res["round_p50_s"] = _round_breakdown(trace_cur0)
         res["phase_budget"] = _phase_budget(trace_cur0)
         res.update(_hot_loop_metrics(snap))
+        res.update(_capacity_series(snap, elapsed))
         return res
     finally:
         # One failing section must not leak dispatchers, server
@@ -1122,6 +1147,7 @@ def bench_cluster_gray(
             "hedge_wasted": hedge_wasted,
             "repair_certified": snap_rep.get("sync.repair.certified", 0),
             "repair_demoted": snap_rep.get("sync.repair.demoted", 0),
+            **_capacity_series(snap_rep),
         }
     finally:
         if hedge_env is None:
@@ -1332,6 +1358,7 @@ def bench_cluster_gateway(
             "verify_fail": w1.get("gateway.cache.verify_fail", 0),
             "setup_s": round(setup_s, 1),
         }
+        res.update(_capacity_series(w1))
         if open_loop > 0:
             res["open_loop"] = _ol_stats(
                 lats_g, open_loop, el_g, readers * reads_per_reader
@@ -1445,6 +1472,7 @@ def bench_cluster_batch(
         flushes = snap.get("dispatch.flushes", 0)
         return {
             **_hot_loop_metrics(snap),
+            **_capacity_series(snap, elapsed),
             "replicas": n_servers,
             "rw_nodes": n_rw,
             "writers": writers,
@@ -1658,6 +1686,7 @@ def bench_cluster_shards(
                     if k.startswith(("piggyback", "backfills", "tail"))
                 }
             )
+            entry.update(_capacity_series(snap))
             if zipf > 0:
                 entry["zipf_s"] = zipf
                 entry["write_conflicts"] = sum(conflicts)
@@ -1906,6 +1935,7 @@ def bench_cluster_split(
                 snap.get("client.write.latency.p50", 0), 4
             ),
             "setup_s": round(setup_s, 1),
+            **_capacity_series(snap),
         }
     finally:
         dispatch.uninstall_all()
@@ -2166,6 +2196,7 @@ def bench_cluster_sidecar(
             "sign_remote": snap.get("sidecar.items{op=sign}", 0),
             "verify_remote": snap.get("sidecar.items{op=verify}", 0),
             "setup_s": round(setup_s, 1),
+            **_capacity_series(snap, shared["elapsed_s"]),
         }
     finally:
         srv.service.stop()
@@ -2672,7 +2703,12 @@ def main() -> None:
                 extra[name] = {"error": "section subprocess hung or crashed"}
             else:
                 extra[name] = payload["result"]
-                extra[name]["backend"] = "cpu"
+                # Core count IS the CPU backend class: the cluster
+                # sections saturate threads, so a 1-core box and an
+                # 8-core box produce incomparable numbers — the same
+                # reported-never-compared rule as tpu-vs-cpu
+                # (tools/bench_compare.py).
+                extra[name]["backend"] = f"cpu/{os.cpu_count()}"
                 meta = meta or payload
             counts["cpu"] += 1
             continue
@@ -2742,7 +2778,8 @@ def main() -> None:
             else:
                 extra[name] = payload["result"]
                 extra[name]["backend"] = (
-                    "cpu (accelerator unreachable; CPU fallback)"
+                    f"cpu/{os.cpu_count()} "
+                    "(accelerator unreachable; CPU fallback)"
                 )
             counts["cpu"] += 1
         else:
@@ -2755,7 +2792,7 @@ def main() -> None:
     # is TPU-backed; cached sections are enumerated honestly.
     n_tpu = counts["tpu"] + counts["cached"]
     if deliberate_cpu:
-        backend = "cpu"
+        backend = f"cpu/{os.cpu_count()}"
     elif n_tpu and not counts["cpu"] and not counts["skipped"]:
         backend = "tpu"
     elif n_tpu:
@@ -2868,8 +2905,10 @@ def _compact_extra(extra: dict, configs: list, headline_from) -> dict:
         backend = str(sec.get("backend", "?"))
         if "cached_from" in sec:
             status = "cached-stale" if sec.get("cached_stale_code") else "cached"
-        elif backend.startswith("cpu ("):
-            status = "cpu-fallback"
+        elif backend.startswith("cpu") and "(" in backend:
+            # Keep the core-count class in the compact status:
+            # "cpu/8 (accelerator unreachable…)" → "cpu/8-fallback".
+            status = backend.split(" ", 1)[0] + "-fallback"
         else:
             status = backend
         num = next(

@@ -45,6 +45,10 @@ class AdmissionQueue:
         self.max_queue = max_queue
         self.max_wait = max_wait
         self.metric = metric
+        #: Capacity-plane tier label ("gateway" / "sidecar"), derived
+        #: from the shed-counter prefix so both tiers publish under the
+        #: one closed `resource` dimension without a new ctor knob.
+        self.tier = metric.split(".", 1)[0]
         self._cv = threading.Condition()
         self._inflight = 0
         self._waiting = 0
@@ -53,18 +57,34 @@ class AdmissionQueue:
         #: must not report tier-wide totals as this instance's own.
         self.shed = 0
 
+    def _publish(self) -> None:
+        """Capacity-plane gauges (caller holds ``_cv``; the metrics
+        registry lock is a leaf, same order ``incr`` already uses)."""
+        lab = {"resource": self.tier}
+        metrics.gauge("admission.inflight", float(self._inflight), labels=lab)
+        metrics.gauge("admission.waiting", float(self._waiting), labels=lab)
+        metrics.gauge("admission.limit", float(self.max_inflight), labels=lab)
+        metrics.gauge("admission.queue_limit", float(self.max_queue), labels=lab)
+
     def acquire(self, op: str) -> bool:
         """True = admitted (caller MUST release); False = shed."""
-        deadline = time.monotonic() + self.max_wait
+        t0 = time.monotonic()
+        deadline = t0 + self.max_wait
         with self._cv:
             if self._inflight < self.max_inflight:
                 self._inflight += 1
+                self._publish()
+                metrics.observe(
+                    "admission.wait", 0.0, labels={"resource": self.tier}
+                )
                 return True
             if self._waiting >= self.max_queue:
                 self.shed += 1
                 metrics.incr(self.metric, labels={"op": op})
+                self._publish()
                 return False
             self._waiting += 1
+            self._publish()
             try:
                 while self._inflight >= self.max_inflight:
                     remaining = deadline - time.monotonic()
@@ -74,16 +94,28 @@ class AdmissionQueue:
                             metrics.incr(
                                 self.metric, labels={"op": op}
                             )
+                            metrics.observe(
+                                "admission.wait",
+                                time.monotonic() - t0,
+                                labels={"resource": self.tier},
+                            )
                             return False
                 self._inflight += 1
+                metrics.observe(
+                    "admission.wait",
+                    time.monotonic() - t0,
+                    labels={"resource": self.tier},
+                )
                 return True
             finally:
                 self._waiting -= 1
+                self._publish()
 
     def release(self) -> None:
         with self._cv:
             self._inflight -= 1
             self._cv.notify()
+            self._publish()
 
     def depth(self) -> tuple[int, int]:
         with self._cv:

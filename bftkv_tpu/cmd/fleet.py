@@ -26,7 +26,54 @@ import time
 
 from bftkv_tpu.obs import FleetCollector, HTTPSource
 
-__all__ = ["main", "render"]
+__all__ = ["main", "render", "render_budget", "render_capacity"]
+
+
+def render_capacity(doc: dict) -> str:
+    """The ``--capacity`` table: per member, each exposed resource's
+    USE row (utilization / saturation / errors), then the ranked
+    bottleneck verdict — the "what do we fix next to go faster"
+    answer (DESIGN.md §20)."""
+    cap = doc.get("capacity") or {}
+    members = cap.get("members") or {}
+    lines: list[str] = []
+    for member, rows in sorted(members.items()):
+        if not rows:
+            continue
+        lines.append(f"capacity · {member}:")
+        for res, row in sorted(
+            rows.items(), key=lambda kv: -kv[1]["saturation"]
+        ):
+            bar = "#" * max(int(row["saturation"] * 24), 0)
+            extras = []
+            for k in ("items_per_launch", "mbps", "runnable", "backlog",
+                      "batch_fill", "fsync_per_s"):
+                v = row.get(k)
+                if v not in (None, 0, 0.0):
+                    extras.append(f"{k}={v:g}")
+            disps = row.get("dispatchers") or {}
+            for dname, d in sorted(disps.items()):
+                occ = d.get("device_occupancy") or {}
+                for w, o in sorted(occ.items()):
+                    extras.append(f"{dname}[{w}]={o:.2f}")
+                if d.get("items_per_launch"):
+                    extras.append(
+                        f"{dname}/launch={d['items_per_launch']:g}"
+                    )
+            lines.append(
+                f"  {res:<12} util {row['utilization']:>5.0%}  "
+                f"sat {row['saturation']:>5.0%}  "
+                f"err {row['errors']:g}  {bar}"
+                + ("  (" + ", ".join(extras) + ")" if extras else "")
+            )
+    verdict = cap.get("verdict") or {}
+    lines.append(f"verdict: {verdict.get('summary', 'no capacity data')}")
+    for r in (verdict.get("ranked") or [])[:5]:
+        lines.append(
+            f"  {r['score']:.3f}  {r['resource']:<12} on {r['member']} "
+            f"(sat {r['saturation']:.2f} x weight {r['phase_weight']:.2f})"
+        )
+    return "\n".join(lines)
 
 
 def render_budget(doc: dict) -> str:
@@ -246,6 +293,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="one-shot: per-shard critical-path budget table "
                          "(phase shares + p99 exemplar; implies 2 scrapes "
                          "— attribution defers one scrape for stitching)")
+    ap.add_argument("--capacity", action="store_true",
+                    help="one-shot: USE-method capacity table + bottleneck "
+                         "verdict (implies 2 scrapes — saturation judges "
+                         "per-scrape deltas)")
     ap.add_argument("--bundle", default=None, metavar="DIR", nargs="?",
                     const="",
                     help="one-shot: write a flight-recorder bundle of "
@@ -302,7 +353,7 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     doc = None
-    scrapes = max(args.scrapes, 2 if args.budget else 1)
+    scrapes = max(args.scrapes, 2 if args.budget or args.capacity else 1)
     for i in range(scrapes):
         if i:
             time.sleep(args.interval)
@@ -354,6 +405,8 @@ def main(argv: list[str] | None = None) -> int:
         print(render(doc))
         if args.budget:
             print(render_budget(doc))
+        if args.capacity:
+            print(render_capacity(doc))
         for name, text in (profiles or {}).items():
             print(f"--- profile {name} ---")
             print(text, end="")

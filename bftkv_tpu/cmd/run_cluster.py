@@ -244,8 +244,12 @@ def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(description="bftkv cluster runner")
     ap.add_argument("--keys", required=True, help="directory of home dirs")
     ap.add_argument("--db-root", required=True)
+    # The log engine is the cluster default since PR 17 (group commit
+    # beats per-write fsync pairs under any concurrency; bench r9/r10
+    # cluster_4_log vs cluster_4) — plain stays selectable, and the
+    # single-daemon CLI (cmd/bftkv.py) keeps its plain default.
     ap.add_argument("--storage", choices=["plain", "log", "native", "mem"],
-                    default=flags.get("BFTKV_STORAGE") or "plain")
+                    default=flags.get("BFTKV_STORAGE") or "log")
     ap.add_argument("--api-base", type=int, default=0,
                     help="client API port for the first server, +1 each")
     ap.add_argument("--client-home", default="",

@@ -61,6 +61,7 @@ STEP_KINDS = (
     "slow_node",
     "route_flap",
     "sidecar_crash",
+    "overload",
 )
 
 
@@ -148,6 +149,7 @@ _BUNDLE_OK_KINDS: dict[str, set] = {
     "sidecar_crash": {"sidecar_down", "sidecar_dishonest"},
     "crash_restart": {"member_down"},
     "slow_node": {"fault", "gray_member"},
+    "overload": {"resource_saturated"},
 }
 
 
@@ -282,7 +284,13 @@ class Nemesis:
                 # No embedded sidecar armed: degrade like route_flap
                 # so one seeded plan stays runnable everywhere.
                 kind = "partition"
-            if kind == "sidecar_crash":
+            if kind == "overload" and self._overload_queue() is None:
+                # No admission-bearing component (no sidecar, no
+                # gateways): nothing to clamp — degrade, same rule.
+                kind = "partition"
+            if kind == "overload":
+                pool = [self._overload_queue()[1]]
+            elif kind == "sidecar_crash":
                 pool = ["sidecar01"]
             elif kind == "route_flap":
                 # The held-back principal is a CLIENT: its writes keep
@@ -369,6 +377,37 @@ class Nemesis:
                 rule_id=rule_id or f"slow_node:{target}",
             )
         ]
+
+    def _overload_queue(self):
+        """``(AdmissionQueue, member label)`` for the overload step —
+        the embedded sidecar's admission when armed, else the first
+        gateway's — or None when the cluster has neither (plan()
+        degrades the step)."""
+        if self.sidecar_ctl is not None:
+            return self.sidecar_ctl.srv.service.admission, "sidecar01"
+        gws = getattr(self.cluster, "gateways", None) or []
+        if gws:
+            return gws[0].admission, gws[0].self_node.name
+        return None
+
+    def overload_burst(self, adm, contenders: int = 4) -> None:
+        """One saturated burst against a clamped admission queue: hold
+        the only slot, throw contenders at the one queue slot — the
+        overflow sheds instantly, the waiters time out, and the wait
+        histogram + gauges record the clamp for the capacity plane."""
+        held = adm.acquire("chaos-overload")
+        threads = [
+            threading.Thread(
+                target=adm.acquire, args=("chaos-overload",), daemon=True
+            )
+            for _ in range(contenders)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if held:
+            adm.release()
 
     def clock_skew(
         self, target: str, delta: int, rule_id: str = ""
@@ -674,6 +713,18 @@ class Nemesis:
                     if a["kind"] in ("sidecar_down", "sidecar_dishonest"):
                         return a["kind"]
                 return None
+            if kind == "overload":
+                # The clamped admission tier must surface through the
+                # capacity plane's hysteresis: a resource_saturated
+                # anomaly naming admission (the gauges ride the
+                # process-wide feed, so kind+detail is the match).
+                for a in fresh:
+                    if (
+                        a["kind"] == "resource_saturated"
+                        and "admission" in a["detail"]
+                    ):
+                        return "resource_saturated"
+                return None
             if kind == "crash_restart":
                 # The plane "sees" an outage either as a fresh
                 # member_down transition or as the member simply BEING
@@ -883,6 +934,31 @@ class Nemesis:
                         "failed_writes": self.failures["write"] - w0,
                     }
                 )
+        elif kind == "overload":
+            # The saturation oracle: clamp a real admission queue to
+            # one slot, drive bursts past it for BFTKV_SAT_SCRAPES
+            # consecutive scrapes, and require the capacity plane's
+            # resource_saturated anomaly (DESIGN.md §20) to name the
+            # clamped resource in the feed — the chaos-side proof that
+            # the USE hysteresis fires on genuine induced overload,
+            # not just in unit tests.
+            adm, _label = self._overload_queue()
+            saved = (adm.max_inflight, adm.max_queue, adm.max_wait)
+            adm.max_inflight, adm.max_queue, adm.max_wait = 1, 1, 0.05
+            try:
+                k = max(flags.get_int("BFTKV_SAT_SCRAPES") or 3, 1)
+                for _ in range(k + 1):
+                    self.overload_burst(adm)
+                    if self.collector is not None:
+                        self.collector.scrape_once()
+                self.traffic(tag)
+                self._observe_window(step, seq0)
+            finally:
+                (
+                    adm.max_inflight,
+                    adm.max_queue,
+                    adm.max_wait,
+                ) = saved
         elif kind == "stale_replay":
             rules = byzantine.make_stale_replayer(self.registry, target)
             try:

@@ -240,7 +240,8 @@ _flag("BFTKV_PLAIN_CACHE", "1024", "int",
       "PlainStorage write-through record cache (entries; 0 disables).")
 _flag("BFTKV_STORAGE", None, "str",
       "Default `--storage` engine for the daemon/cluster CLIs "
-      "(plain|log|native|mem; unset: plain).")
+      "(plain|log|native|mem; unset: plain for the daemon, log for "
+      "run_cluster).")
 _flag("BFTKV_LOG_SEGMENT_MB", "64", "int",
       "LogStorage segment size: the active segment seals past this "
       "and becomes a shippable snapshot unit (DESIGN.md §19).")
@@ -251,6 +252,11 @@ _flag("BFTKV_LOG_GROUP_COMMIT_MS", "2", "float",
 _flag("BFTKV_LOG_COMPACT_TRIGGER", "0.5", "float",
       "LogStorage background compaction trigger: sealed dead-byte "
       "ratio past which a compaction pass starts (0 disables).")
+_flag("BFTKV_LOG_COMPACT_MBPS", None, "float",
+      "Compaction IO governor: sustained copy-rate cap in MB/s "
+      "(token-bucket sleep between record copies; unset/0 = "
+      "ungoverned).  Throttle time surfaces as compact_io saturation "
+      "in the capacity plane.")
 
 _begin("Observability & tooling")
 _flag("BFTKV_TRACE", "on", "switch",
@@ -290,6 +296,21 @@ _flag("BFTKV_RECORDER_MIN_INTERVAL", "5", "float",
 _flag("BFTKV_RECORDER_MAX_MB", "64", "int",
       "Total on-disk cap across flight-recorder bundles; oldest "
       "bundles are evicted first.")
+_flag("BFTKV_SAT_THRESHOLD", "0.8", "float",
+      "Capacity plane: per-resource saturation at or above this for "
+      "BFTKV_SAT_SCRAPES consecutive traffic-bearing scrapes raises "
+      "the resource_saturated anomaly (0 disables).")
+_flag("BFTKV_SAT_SCRAPES", "3", "int",
+      "Consecutive saturated scrapes before resource_saturated fires "
+      "— same hysteresis contract as slo_burn (one episode, one "
+      "anomaly; a clean scrape re-arms).")
+_flag("BFTKV_SAT_WAIT_REF", "0.25", "float",
+      "Capacity plane: queue-wait p99 (seconds) that maps to "
+      "saturation 1.0 for wait-derived resources (admission, "
+      "dispatch; the log commit path uses max(4x linger, this)).")
+_flag("BFTKV_GIL_SAMPLER", "1", "switch",
+      "GIL-pressure estimate (runnable-thread gauge) riding the "
+      "profiler tick; costs nothing while the profiler is disarmed.")
 
 # ---------------------------------------------------------------------------
 # The read seam.

@@ -60,6 +60,8 @@ LABEL_KEYS = (
     "peer",       # normalized link name (bounded by fleet size)
     "event",      # visual/ws event type
     "kind",       # autopilot plan kind: split / retire
+    "resource",   # capacity-plane resource (closed capacity.RESOURCES enum)
+    "width",      # device batch limb-width group (bounded: few limb sizes + "ec")
     "le",         # histogram bucket bound (fixed BUCKETS ladder)
 )
 

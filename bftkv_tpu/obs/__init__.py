@@ -33,13 +33,18 @@ a shared observability plane):
 - :mod:`bftkv_tpu.obs.profiler` — opt-in wall-clock sampling profiler
   (collapsed flamegraph stacks, ``/profile?seconds=N`` per daemon);
 - :mod:`bftkv_tpu.obs.recorder` — the flight recorder: anomaly-driven,
-  rate-limited, size-capped black-box bundles of every diagnostic ring.
+  rate-limited, size-capped black-box bundles of every diagnostic ring;
+- :mod:`bftkv_tpu.obs.capacity` — the USE-method capacity plane over
+  the closed resource vocabulary + the bottleneck-verdict engine
+  (``/fleet`` ``capacity``, ``cmd.fleet --capacity``, DESIGN.md §20).
 
 Entry points: ``python -m bftkv_tpu.cmd.fleet`` (one-shot, ``--watch``,
-``--listen``, ``--budget``, ``--bundle``) and ``run_cluster --fleet``.
-Design: docs/DESIGN.md §11 (health plane) + §18 (diagnosis tier).
+``--listen``, ``--budget``, ``--capacity``, ``--bundle``) and
+``run_cluster --fleet``.  Design: docs/DESIGN.md §11 (health plane) +
+§18 (diagnosis tier) + §20 (capacity plane).
 """
 
+from bftkv_tpu.obs.capacity import CapacityPlane
 from bftkv_tpu.obs.collector import FleetCollector
 from bftkv_tpu.obs.critpath import PhaseBudget, attribute
 from bftkv_tpu.obs.recorder import FlightRecorder
@@ -47,6 +52,7 @@ from bftkv_tpu.obs.source import HTTPSource, LocalSource
 from bftkv_tpu.obs.stitch import Stitcher
 
 __all__ = [
+    "CapacityPlane",
     "FleetCollector",
     "FlightRecorder",
     "HTTPSource",

@@ -40,6 +40,7 @@ from collections import OrderedDict, deque
 
 from bftkv_tpu import flags
 from bftkv_tpu.metrics import BUCKETS, histogram_quantile
+from bftkv_tpu.obs.capacity import CapacityPlane
 from bftkv_tpu.obs.critpath import ROOT_OPS, PhaseBudget, attribute
 from bftkv_tpu.obs.stitch import Stitcher
 from bftkv_tpu.devtools.lockwatch import named_lock
@@ -199,6 +200,10 @@ class FleetCollector:
         #: breach counts with hysteresis.
         self._burn_prev: dict = {}
         self._burn_count: dict = {}
+        #: Capacity plane (DESIGN.md §20): per-member USE rows folded
+        #: from every scraped metrics snapshot + the local feed, the
+        #: bottleneck verdict, and the resource_saturated hysteresis.
+        self.capacity = CapacityPlane()
         self._local_ring_dropped = 0
         self._local_slow_dropped = 0
         #: Anomaly listeners (the flight recorder's feed), called
@@ -535,6 +540,7 @@ class FleetCollector:
                     name, shard, m.prev_counters, snap
                 )
                 self._merge_slo(slo_counts, slo_sums, snap)
+                self.capacity.observe(name, snap)
                 m.status = "up"
                 m.last_ok = time.time()
                 m.last_err = ""
@@ -564,6 +570,7 @@ class FleetCollector:
                 "process", None, self._local_prev, snap
             )
             self._merge_slo(slo_counts, slo_sums, snap)
+            self.capacity.observe("process", snap)
         if self.local_tracer is not None:
             texp = self.local_tracer.export(self._local_cursor)
             self._local_cursor = texp["cursor"]
@@ -593,6 +600,18 @@ class FleetCollector:
         # on this scrape's delta — both AFTER every feed was ingested.
         self._attribute_pass()
         self._slo_burn_check(slo_counts)
+        # Capacity hysteresis (DESIGN.md §20): sustained per-resource
+        # saturation becomes resource_saturated — same episode contract
+        # as slo_burn, emitted through the feed so the flight recorder
+        # snapshots capacity state with the bundle.
+        for ev in self.capacity.check():
+            self._emit(
+                "resource_saturated",
+                ev["member"],
+                self._shard_of_member(ev["member"]),
+                f"{ev['resource']} saturation {ev['saturation']:.2f} "
+                f"(utilization {ev['utilization']:.2f})",
+            )
 
         with self._lock:
             if slo_counts:
@@ -808,6 +827,15 @@ class FleetCollector:
             # op's wall clock went, exclusive per phase (DESIGN.md §18).
             "write_budget_by_phase": budget_doc.get("write", {}),
             "read_budget_by_phase": budget_doc.get("read", {}),
+            # Capacity plane (DESIGN.md §20): USE rows per member +
+            # fleet fold + the bottleneck verdict, joined against the
+            # write budget's phase shares above.
+            "capacity": {
+                **self.capacity.doc(),
+                "verdict": self.capacity.verdict(
+                    PhaseBudget.fleet_shares(budget_doc)
+                ),
+            },
             "shards": shards_doc,
             "gateways": self._gateways(all_members, now),
             "sidecars": self._sidecars(all_members, now),
@@ -869,6 +897,21 @@ class FleetCollector:
                     if isinstance(q.get(field), (int, float)):
                         add(f"sidecar_{field}", "gauge", lab,
                             str(q[field]))
+        # Capacity plane: ONE gauge family per USE axis, labeled
+        # (member, resource) — resource names are the closed
+        # capacity.RESOURCES enum, so cardinality is members x |enum|.
+        cap = doc.get("capacity") or {}
+        for member, rows in sorted((cap.get("members") or {}).items()):
+            for res, row in sorted(rows.items()):
+                lab = f'{{member="{member}",resource="{res}"}}'
+                for field in ("utilization", "saturation", "errors"):
+                    add(f"resource_{field}", "gauge", lab,
+                        str(row.get(field, 0)))
+        top = (cap.get("verdict") or {}).get("top")
+        if top:
+            add("resource_verdict_score", "gauge",
+                f'{{member="{top["member"]}",resource="{top["resource"]}"}}',
+                str(top["score"]))
         add("traces_stitched", "gauge", "",
             str(doc["traces"]["stitched"]))
         drops = doc["fleet"].get("trace_drops") or {}

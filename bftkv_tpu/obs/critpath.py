@@ -333,6 +333,24 @@ class PhaseBudget:
                 }
             return out
 
+    @staticmethod
+    def fleet_shares(budget_doc: dict, op: str = "write") -> dict:
+        """Fleet-wide ``{phase: share}`` over one op, each shard's
+        shares weighted by the wall clock that shard's roots actually
+        spent (``root_sum_s``) — the verdict join's input (§20): a
+        phase dominating a busy shard outweighs the same phase idling
+        on a quiet one.  Empty before any trace was attributed."""
+        agg: dict[str, float] = {}
+        total = 0.0
+        for sh_doc in budget_doc.get(op, {}).values():
+            w = sh_doc.get("root_sum_s") or 0.0
+            if w <= 0:
+                continue
+            total += w
+            for ph, pd in (sh_doc.get("phases") or {}).items():
+                agg[ph] = agg.get(ph, 0.0) + w * (pd.get("share") or 0.0)
+        return {ph: v / total for ph, v in agg.items()} if total else {}
+
     def reset(self) -> None:
         with self._lock:
             self._phase_hist.clear()

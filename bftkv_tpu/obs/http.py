@@ -4,7 +4,9 @@ Same stance as the daemon API (cmd/bftkv.py): stdlib-only threading
 HTTP server, content negotiation on one path — scrapers asking for
 text (or ``?format=prometheus``) get the exposition, everyone else the
 full JSON health document.  ``/fleet/trace/<id>`` serves one stitched
-trace as a nested tree.
+trace as a nested tree; ``/fleet/capacity`` serves just the capacity
+section (USE rows + bottleneck verdict, DESIGN.md §20) for dashboards
+that poll only the planning signal.
 """
 
 from __future__ import annotations
@@ -72,6 +74,20 @@ class _FleetHandler(BaseHTTPRequestHandler):
                 self._reply(
                     200,
                     json.dumps(tree, sort_keys=True, default=str).encode(),
+                    "application/json",
+                )
+            elif path == "/fleet/capacity" or path.startswith(
+                "/fleet/capacity?"
+            ):
+                # Just the capacity section — the health document is
+                # large; a saturation dashboard needs only this.
+                self._reply(
+                    200,
+                    json.dumps(
+                        collector.health().get("capacity") or {},
+                        sort_keys=True,
+                        default=str,
+                    ).encode(),
                     "application/json",
                 )
             elif path == "/fleet" or path.startswith("/fleet?"):

@@ -31,8 +31,21 @@ import time
 
 from bftkv_tpu import flags
 from bftkv_tpu.devtools.lockwatch import named_lock
+from bftkv_tpu.metrics import registry as metrics
 
 __all__ = ["Profiler", "enabled", "ensure_started", "last", "profile_for"]
+
+#: Leaf-frame function names that mean a thread is parked, not
+#: competing for the GIL — the blocking primitives (lock/CV waits,
+#: socket waits, queue gets, sleeps).  A leaf NOT in this set is
+#: counted as runnable by the GIL-pressure estimate below; the set errs
+#: toward "runnable" because a false runnable inflates the estimate
+#: (visible, self-correcting) while a false blocked hides pressure.
+_BLOCKED_LEAVES = frozenset({
+    "wait", "acquire", "sleep", "select", "poll", "epoll", "accept",
+    "recv", "recv_into", "read", "readinto", "readline", "get",
+    "join", "settimeout", "connect", "getaddrinfo",
+})
 
 
 def enabled() -> bool:
@@ -90,9 +103,12 @@ class Profiler:
         # frames may keep running while we walk them — a torn co_name
         # is impossible (strings are immutable), at worst a stack is
         # one frame stale, which sampling tolerates by definition.
+        runnable = 0
         for tid, frame in sys._current_frames().items():
             if tid == me:
                 continue
+            if frame.f_code.co_name not in _BLOCKED_LEAVES:
+                runnable += 1
             stack = self._fold(frame)
             with self._lock:
                 if stack in self._counts:
@@ -103,6 +119,16 @@ class Profiler:
                     self._overflow += 1
                 self._samples += 1
             n += 1
+        # GIL-pressure estimate: threads whose leaf frame is NOT a
+        # blocking primitive are runnable — i.e. queued on the GIL.
+        # Rides the sampler tick, so it costs nothing when the profiler
+        # is disarmed (no sampler, no gauge) and the capacity plane's
+        # gil resource simply reports absent.
+        if flags.enabled("BFTKV_GIL_SAMPLER"):
+            metrics.gauge(
+                "gil.runnable", float(runnable),
+                labels={"resource": "gil"},
+            )
         return n
 
     def _run(self) -> None:

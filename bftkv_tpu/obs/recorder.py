@@ -193,6 +193,15 @@ class FlightRecorder:
         if self.collector is not None:
             feeds["health.json"] = self.collector.health
             feeds["anomalies.json"] = self.collector.anomalies
+            # The capacity plane standalone (it also rides health.json):
+            # a resource_saturated bundle must answer "what was full"
+            # on a laptop without digging through the health document.
+            cap = getattr(self.collector, "capacity", None)
+            if cap is not None:
+                feeds["capacity.json"] = lambda: {
+                    **cap.doc(),
+                    "verdict": cap.verdict(),
+                }
         fp_registry = self.fp_registry
         if fp_registry is None:
             from bftkv_tpu.faults import failpoint as fp

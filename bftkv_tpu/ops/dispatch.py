@@ -412,6 +412,17 @@ class _BatchDispatcher:
         metrics.gauge(f"{self.name}.occupancy", occupancy)
         metrics.incr(f"{self.name}.flushes")
         metrics.incr(f"{self.name}.items", len(flat))
+        # Device-occupancy: items-per-LAUNCH vs the calibrated max batch.
+        # Distinct from ``.occupancy`` when an oversized flush chunks
+        # into several launches — each launch is then near-full even
+        # though flat/max_batch > 1 (capacity plane reads this gauge).
+        launches = max(1, -(-len(flat) // self.max_batch))
+        metrics.incr(f"{self.name}.launches", launches)
+        metrics.gauge(
+            f"{self.name}.device_occupancy",
+            len(flat) / (launches * self.max_batch),
+            labels={"width": "all"},
+        )
         t0 = time.perf_counter()
         # Each flush is its own (root) trace: device batches are shared
         # across requests, so they cannot belong to any one request's
@@ -593,6 +604,13 @@ class SignDispatcher(_BatchDispatcher):
             msg, key = items[i]
             groups.setdefault(id(key), (key, []))[1].append((i, msg))
         for key, pairs in groups.values():
+            # EC entry point occupancy: one nonce base-mult launch per
+            # key group; fill is this group's share of the batch cap.
+            metrics.gauge(
+                "signdispatch.device_occupancy",
+                min(1.0, len(pairs) / self.max_batch),
+                labels={"width": "ec"},
+            )
             for (i, _), sig in zip(
                 pairs, _ecdsa.sign_batch([m for _, m in pairs], key)
             ):
@@ -679,6 +697,14 @@ class ModexpDispatcher(_BatchDispatcher):
                     vals = None  # incapable/hostile moduli: host below
                 if vals is not None:
                     metrics.incr("modexp.device", len(idxs))
+                    # Per-limb-width device occupancy: widths are the
+                    # handful of deployed modulus sizes, so the label
+                    # stays bounded (capacity plane joins on `width`).
+                    metrics.gauge(
+                        "modexpdispatch.device_occupancy",
+                        min(1.0, len(idxs) / self.max_batch),
+                        labels={"width": str(w)},
+                    )
                     for i, v in zip(idxs, vals):
                         out[i] = int(v)
         from bftkv_tpu.crypto import rsa as rsamod

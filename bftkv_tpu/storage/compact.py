@@ -34,12 +34,45 @@ open-time supersede rule deletes the covered inputs (idempotent).
 from __future__ import annotations
 
 import os
+import time
 
+from bftkv_tpu import flags
 from bftkv_tpu import packet as pkt
 from bftkv_tpu.errors import ERR_NOT_FOUND
+from bftkv_tpu.metrics import registry as metrics
 from bftkv_tpu.storage import segment as seg
 
 __all__ = ["compact_store"]
+
+
+class _RateGovernor:
+    """Token-bucket IO governor for the compactor's copy loop.
+
+    ``BFTKV_LOG_COMPACT_MBPS`` caps the sustained copy rate: each
+    record written debits its bytes, and whenever the copy runs ahead
+    of the configured rate the compactor sleeps off the surplus —
+    between record copies, never while holding the store lock, so
+    foreground writes and the fsync barrier keep their own pace while
+    compaction IO stops competing with them for the disk.  Unset or 0
+    = ungoverned (the pre-governor behaviour).  Throttle sleeps are
+    observable (``storage.compact.throttle``) so a governed compaction
+    that can't keep up with dead-byte accrual shows as compact_io
+    saturation in the capacity plane rather than as mystery latency.
+    """
+
+    def __init__(self, mbps: float | None):
+        self.rate = max(0.0, (mbps or 0.0)) * 1024 * 1024
+        self._t0 = time.monotonic()
+        self._bytes = 0
+
+    def debit(self, n: int) -> None:
+        if self.rate <= 0:
+            return
+        self._bytes += n
+        ahead = self._bytes / self.rate - (time.monotonic() - self._t0)
+        if ahead > 0.001:
+            metrics.observe("storage.compact.throttle", ahead)
+            time.sleep(ahead)
 
 
 def _max_certified(store, variable: bytes, cache: dict) -> int | None:
@@ -110,6 +143,8 @@ def compact_store(store) -> dict:
     dropped: list[tuple[bytes, int, tuple[int, int], int]] = []
     in_bytes = 0
     out_size = 0
+    gov = _RateGovernor(flags.get_float("BFTKV_LOG_COMPACT_MBPS"))
+    copy_t0 = time.monotonic()
     with open(tmp, "wb") as out:
         for fkey, path in inputs:
             in_bytes += os.path.getsize(path)
@@ -137,6 +172,7 @@ def compact_store(store) -> dict:
                         out_size + seg.HEADER.size + len(variable)
                     )
                     out.write(buf)
+                    gov.debit(len(buf))
                     survivors.append(
                         (variable, t, fkey, voff, new_voff, len(buf))
                     )
@@ -185,6 +221,13 @@ def compact_store(store) -> dict:
             os.unlink(p)
         except OSError:
             pass  # already gone (open-time supersede recovery raced us)
+    # Compaction IO accounting (capacity plane: compact_io resource).
+    metrics.incr("storage.compact.read_bytes", in_bytes)
+    metrics.incr("storage.compact.written_bytes", out_size)
+    dt = max(1e-9, time.monotonic() - copy_t0)
+    metrics.gauge(
+        "storage.compact.mbps", (in_bytes + out_size) / dt / (1024 * 1024)
+    )
     return {
         "inputs": len(inputs),
         "kept": len(survivors),

@@ -80,6 +80,9 @@ class LogStorage:
                 flags.get_float("BFTKV_LOG_GROUP_COMMIT_MS") / 1000.0
             )
         self.group_commit_s = max(0.0, group_commit_s)
+        # Published once: the capacity plane reads the linger window as
+        # the commit-wait saturation denominator (DESIGN.md §20).
+        metrics.gauge("storage.log.linger_ms", self.group_commit_s * 1000.0)
         if compact_trigger is None:
             compact_trigger = flags.get_float("BFTKV_LOG_COMPACT_TRIGGER")
         self.compact_trigger = compact_trigger
@@ -247,6 +250,18 @@ class LogStorage:
         is fsynced.  One caller at a time leads the fsync; everyone who
         lost the race piggybacks on the leader's barrier instead of
         issuing their own — N concurrent writers, one fsync."""
+        t0 = time.monotonic()
+        try:
+            self._commit_inner(pos)
+        finally:
+            # Commit-wait = linger + fsync + barrier queueing; its p99
+            # against the configured linger is the log_commit
+            # saturation signal (capacity plane, DESIGN.md §20).
+            metrics.observe(
+                "storage.log.commit_wait", time.monotonic() - t0
+            )
+
+    def _commit_inner(self, pos: tuple[int, int]) -> None:
         while True:
             with self._cv:
                 if self._flushed >= pos:
@@ -264,6 +279,14 @@ class LogStorage:
                 with self._lock:
                     snap = (self._seq, self._size)
                     f = self._f
+                if fp.ARMED:
+                    # ``storage.fsync`` failpoint: a stalled durability
+                    # barrier — every writer joined on this group
+                    # commit waits it out (slow-disk model; the
+                    # capacity plane must name log_commit for it).
+                    act = fp.fire("storage.fsync", backend="log")
+                    if act is not None and act.kind == "stall":
+                        time.sleep(fp.delay_seconds(act))
                 try:
                     os.fsync(f.fileno())
                 except ValueError:

@@ -470,6 +470,17 @@ class SyncDaemon:
             scan_window=self.REPAIR_SCAN_MAX,
         )
         cycle_done = self._scan_cursor is None
+        # Cursor lag for the capacity plane: 1.0 = this round's scan
+        # window came back full (the cursor cannot cover the keyspace
+        # in one round — repair is running behind residue accrual);
+        # 0.0 = the cycle completed inside the window.
+        metrics.gauge(
+            "sync.repair.cursor_lag",
+            0.0 if cycle_done else min(
+                1.0, len(pending) / max(1, self.REPAIR_SCAN_MAX)
+            ),
+        )
+        metrics.gauge("sync.repair.backlog", float(len(pending)))
         due: list[tuple[bytes, int, bytes, object]] = []
         for variable, t, raw, p in pending:
             self._cycle_live.add(variable)

@@ -422,6 +422,17 @@ class _DaemonPool:
             else:
                 spawn = False  # cap: task waits for the next free worker
                 metrics.incr("transport.pool.saturated")
+            busy, cap = self._count - self._idle, self._max
+        # Capacity-plane gauges, outside the pool lock (the metrics
+        # registry lock is independent; values are the snapshot above).
+        metrics.gauge(
+            "transport.pool.busy", float(busy),
+            labels={"resource": "fanout_pool"},
+        )
+        metrics.gauge(
+            "transport.pool.cap", float(cap),
+            labels={"resource": "fanout_pool"},
+        )
         self._q.put(fn)
         if spawn:
             threading.Thread(

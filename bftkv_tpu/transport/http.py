@@ -150,6 +150,11 @@ class _ConnPool:
                 idle = self._idle.setdefault((host, port), [])
                 if len(idle) < self.per_peer:
                     idle.append(conn)
+                    total = sum(len(v) for v in self._idle.values())
+                    metrics.gauge(
+                        "transport.conn.idle", float(total),
+                        labels={"resource": "conn_pool"},
+                    )
                     return
         try:
             conn.close()

@@ -186,8 +186,10 @@ def test_fast_mode_capture_rejected_for_full_run(bench, monkeypatch, capsys):
     assert sec["tallies_per_sec"] == 7.0
     assert "cached_from" not in sec
     assert "cpu" in detail["extra"]["backend"]
+    # Fallback statuses carry the core count since r10 (cpu/8-fallback)
+    # so bench_compare can refuse cross-box comparisons.
     assert compact["extra"]["sections"]["revoke_tally_256"] == [
-        "cpu-fallback", 7.0,
+        f"cpu/{os.cpu_count()}-fallback", 7.0,
     ]
 
 

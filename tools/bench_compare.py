@@ -25,9 +25,10 @@ Accepted inputs, auto-detected per file:
   sections under ``extra.sections`` as dicts;
 - a bare ``{"sections": {...}}`` dict.
 
-Sections measured on different backend classes (tpu vs cpu) are
-reported but never compared — a tunnel flap is not a regression.  Use
-from CI::
+Sections measured on different backend classes (tpu vs cpu, or CPU
+boxes with different core counts — ``cpu/8`` vs ``cpu/1``) are
+reported but never compared — a tunnel flap or a driver-box reschedule
+is not a regression.  Use from CI::
 
     python tools/bench_compare.py BENCH_r05.json BENCH_r06.json
 """
@@ -46,12 +47,11 @@ __all__ = [
     "REPORT_ONLY",
 ]
 
-#: Sections printed but never gated.  cluster_4_log rides here for its
-#: FIRST landing round (the cluster_4_gray / cluster_sidecar
-#: precedent): the §19 log engine's first committed numbers seed the
-#: trajectory, and the section gates as soon as a newer round shares
-#: it.  One round, no longer.
-REPORT_ONLY: set = {"cluster_4_log"}
+#: Sections printed but never gated.  Empty since r10: cluster_4_log
+#: rode here for its FIRST landing round (r9, the cluster_4_gray /
+#: cluster_sidecar precedent) and gates now that r10 shares it — the
+#: promotion the one-round grace period promised.
+REPORT_ONLY: set = set()
 
 #: Absolute bound on the NEW record's hedged gray slowdown (write p50
 #: with one delayed clique member ÷ fault-free floor) — the DESIGN.md
@@ -61,8 +61,16 @@ GRAY_SLOWDOWN_MAX = 2.0
 
 
 def _backend_class(status: str) -> str:
+    """Comparability class of a section status.  CPU statuses carry
+    the core count since r10 (``cpu/8``, ``cpu/8-fallback``): the
+    cluster sections saturate threads, so numbers from boxes with
+    different core counts are incomparable — reported, never gated,
+    exactly like tpu-vs-cpu.  Legacy bare ``cpu`` statuses (unknown
+    core count) form their own class for the same reason."""
     s = (status or "").lower()
-    return "cpu" if s.startswith("cpu") else "tpu"
+    if not s.startswith("cpu"):
+        return "tpu"
+    return s.split()[0].split("-")[0]  # "cpu/8[-fallback]" → "cpu/8"
 
 
 def extract_sections(doc: dict) -> dict:
