@@ -207,6 +207,25 @@ def render(doc: dict) -> str:
                 f"trace={ex['trace_id']}"
                 + (f" peer={ex['peer']}" if "peer" in ex else "")
             )
+    regs = doc.get("regions") or {}
+    if regs:
+        rb = regs["f_budget"]
+        lines.append(
+            f"regions: {regs['n']} · region budget "
+            f"{rb['remaining']}/{rb['f']}"
+            + (f" DARK={','.join(rb['dark'])}" if rb["dark"] else "")
+        )
+        for rname, row in sorted(regs["rows"].items()):
+            mark = "✗" if row["dark"] else "·"
+            lines.append(
+                f"  {mark} {rname}: {row['up']}/{row['members']} up"
+                + (f" down={','.join(row['down'])}" if row["down"] else "")
+                + (
+                    f" gw={','.join(row['gateways'])}"
+                    if row["gateways"]
+                    else ""
+                )
+            )
     for name, g in sorted((doc.get("gateways") or {}).items()):
         mark = "·" if g["status"] == "up" else "✗"
         hits, misses = g.get("hits", 0), g.get("misses", 0)
@@ -216,6 +235,12 @@ def render(doc: dict) -> str:
             f"cache {g.get('entries', 0)} entries, "
             f"hit rate {rate:.0%} · shed {g.get('shed', 0)} · "
             f"verify_fail {g.get('verify_fail', 0)}"
+            + (
+                f" · lease serves {g['lease_served']}"
+                f"{' (live)' if g.get('lease_live') else ''}"
+                if g.get("lease_served")
+                else ""
+            )
         )
     for name, s in sorted((doc.get("sidecars") or {}).items()):
         mark = "·" if s["status"] == "up" else "✗"

@@ -35,6 +35,14 @@ def main(argv: list[str] | None = None) -> int:
                          "TOFU uid, each with a dialable address "
                          "(bftkv_tpu.cmd.run_gateway serves one)")
     ap.add_argument("--gw-base-port", type=int, default=6201)
+    ap.add_argument("--regions", type=int, default=0,
+                    help="label every principal round-robin into N "
+                         "regions (r0..rN-1) and write a `regions` "
+                         "file into each home dir: deployment-plane "
+                         "geography for locality-aware staging, "
+                         "per-region latency classes, and the fleet "
+                         "collector's region rollup (DESIGN.md §21); "
+                         "certificates are untouched")
     ap.add_argument("--bits", type=int, default=2048)
     ap.add_argument("--alg", default="rsa", choices=["rsa", "p256", "mixed"],
                     help="identity-key algorithm: RSA-2048, ECDSA P-256, "
@@ -63,7 +71,20 @@ def main(argv: list[str] | None = None) -> int:
         n_shards=args.shards,
         n_gateways=args.gateways,
         gw_base_port=args.gw_base_port,
+        n_regions=args.regions,
     )
+    if args.regions > 1:
+        by_region: dict[str, list[str]] = {}
+        for ident in uni.all:
+            if ident.region:
+                by_region.setdefault(ident.region, []).append(ident.name)
+        print(
+            "regions: "
+            + "; ".join(
+                f"{r}: {','.join(names)}"
+                for r, names in sorted(by_region.items())
+            )
+        )
     if args.shards > 1:
         groups = ", ".join(
             f"shard {i}: {g[0].name}..{g[-1].name}"
@@ -76,6 +97,7 @@ def main(argv: list[str] | None = None) -> int:
         topology.save_home(
             home, ident, uni.view_of(ident),
             local_trust=uni.local_trust_of(ident),
+            regions=uni.regions or None,
         )
         dial = uni.gateway_addrs.get(ident.name, "")
         if dial:

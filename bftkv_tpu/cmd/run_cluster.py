@@ -320,6 +320,12 @@ def main(argv: list[str] | None = None) -> int:
                          "APIs take the ports after the daemons' and "
                          "join the --fleet scrape.  The --shards "
                          "quickstart generates the gw homes too")
+    ap.add_argument("--regions", type=int, default=0, metavar="N",
+                    help="quickstart only: generate the topology with "
+                         "N region labels (genkeys --regions).  Each "
+                         "daemon picks its region up from its home's "
+                         "`regions` file automatically, so an already-"
+                         "generated labeled keyset needs no flag here")
     args = ap.parse_args(argv)
 
     if args.shards and not server_homes(args.keys):
@@ -333,6 +339,7 @@ def main(argv: list[str] | None = None) -> int:
             "--out", args.keys, "--shards", str(args.shards),
             "--servers", "4", "--rw", "4", "--users", "1",
             "--gateways", str(args.gateways),
+            "--regions", str(args.regions),
         ])
 
     homes = server_homes(args.keys)

@@ -91,7 +91,14 @@ class Rule:
     value (or predicate over the value), or a predicate over the whole
     context dict.  ``prob``: fire probability per matching evaluation,
     decided by the seed-hash draw.  ``times``: max fires (``None`` =
-    unlimited).  Remaining kwargs land in ``Action.params``.
+    unlimited).  ``quiet`` rules fire without tracing or counting —
+    the WAN topology plane uses them: a link delay that *is* the
+    deployment geography is an environment, not a fault, and must not
+    flood the trace or the ``fault_injected`` anomaly feed.  A
+    ``background`` rule is evaluated only after every foreground rule
+    at its point declined — so an always-matching topology delay can
+    never shadow a nemesis step's drop rule added later at the same
+    hook.  Remaining kwargs land in ``Action.params``.
     """
 
     __slots__ = (
@@ -103,6 +110,8 @@ class Rule:
         "prob",
         "times",
         "enabled",
+        "quiet",
+        "background",
         "_evals",
         "_fires",
     )
@@ -116,6 +125,8 @@ class Rule:
         match=None,
         prob: float = 1.0,
         times: int | None = None,
+        quiet: bool = False,
+        background: bool = False,
         **params,
     ):
         self.point = point
@@ -126,6 +137,8 @@ class Rule:
         self.prob = prob
         self.times = times
         self.enabled = True
+        self.quiet = quiet
+        self.background = background
         self._evals = 0
         self._fires = 0
 
@@ -215,6 +228,8 @@ class FaultRegistry:
         prob: float = 1.0,
         times: int | None = None,
         rule_id: str | None = None,
+        quiet: bool = False,
+        background: bool = False,
         **params,
     ) -> Rule:
         with self._lock:
@@ -227,9 +242,23 @@ class FaultRegistry:
                 match=match,
                 prob=prob,
                 times=times,
+                quiet=quiet,
+                background=background,
                 **params,
             )
-            self._rules.setdefault(point, []).append(rule)
+            rules = self._rules.setdefault(point, [])
+            if background:
+                rules.append(rule)
+            else:
+                # Foreground rules stay ahead of every background rule
+                # regardless of arrival order: _fire returns the FIRST
+                # match, and a topology delay must never shadow a fault
+                # rule armed later at the same point.
+                i = next(
+                    (j for j, r in enumerate(rules) if r.background),
+                    len(rules),
+                )
+                rules.insert(i, rule)
             return rule
 
     def remove(self, rule: Rule) -> None:
@@ -266,14 +295,17 @@ class FaultRegistry:
                 if rule.prob < 1.0 and p >= rule.prob:
                     continue
                 rule._fires += 1
-                self._seq += 1
-                self._events.append(
-                    FaultEvent(self._seq, point, rule.rule_id, n, rule.kind)
-                )
-                metrics.incr(
-                    "faults.fired",
-                    labels={"point": point, "action": rule.kind},
-                )
+                if not rule.quiet:
+                    self._seq += 1
+                    self._events.append(
+                        FaultEvent(
+                            self._seq, point, rule.rule_id, n, rule.kind
+                        )
+                    )
+                    metrics.incr(
+                        "faults.fired",
+                        labels={"point": point, "action": rule.kind},
+                    )
                 params = dict(rule.params)
                 params["u"] = u
                 return Action(rule.kind, params, rule)
@@ -282,6 +314,24 @@ class FaultRegistry:
     def trace(self) -> list[FaultEvent]:
         with self._lock:
             return list(self._events)
+
+    def would_drop(self, point: str, **ctx) -> bool:
+        """Side-effect-free: would an armed ``drop`` rule match this
+        context right now?  Health probes use it — a probe must
+        OBSERVE a partition (an in-process cut never unregisters the
+        transport) without consuming rule fire budgets, perturbing
+        the seeded parameter draws, or echoing into the fault trace
+        the way a real :meth:`_fire` evaluation would."""
+        with self._lock:
+            for rule in self._rules.get(point, ()):
+                if (
+                    rule.enabled
+                    and rule.kind == "drop"
+                    and (rule.times is None or rule._fires < rule.times)
+                    and rule._matches(ctx)
+                ):
+                    return True
+        return False
 
 
 registry = FaultRegistry()

@@ -148,6 +148,11 @@ class ChaosCluster:
             gw.stop()
         for s in self.all_servers:
             s.tr.stop()
+        if self.universe.regions:
+            # Process-global geography must not outlive its fleet.
+            from bftkv_tpu import regions
+
+            regions.clear()
 
 
 def build_cluster(
@@ -161,11 +166,16 @@ def build_cluster(
     storage_factory=MemStorage,
     n_shards: int = 1,
     n_gateways: int = 0,
+    n_regions: int = 0,
 ) -> ChaosCluster:
     uni = topology.build_universe(
         n_servers, n_users, n_rw, scheme="loop", bits=bits,
-        n_shards=n_shards, n_gateways=n_gateways,
+        n_shards=n_shards, n_gateways=n_gateways, n_regions=n_regions,
     )
+    if uni.regions:
+        from bftkv_tpu import regions
+
+        regions.install(uni.regions)
     net = LoopbackNet()
     recorder = recorder or HistoryRecorder()
     cluster = ChaosCluster(universe=uni, net=net, recorder=recorder)

@@ -22,7 +22,13 @@ Step kinds:
   price);
 - ``stale_replay`` / ``collude`` — Byzantine modes as failpoint
   programs (:mod:`bftkv_tpu.faults.byzantine`): genuinely-signed stale
-  answers, or the full sign-anything/store-anything colluder.
+  answers, or the full sign-anything/store-anything colluder;
+- ``region_partition`` — a WHOLE region loses its WAN egress: every
+  link crossing the region boundary is cut while intra-region links
+  stay up (DESIGN.md §21).  Eligible only for regions whose seats stay
+  within every plane's node-level ``f`` and hold no clients/gateways,
+  so the acceptance bar is ZERO failed writes plus the ``region_down``
+  anomaly naming the negative region-level budget.
 
 Every step touches at most one replica at a time, keeping the
 adversary inside the ``f`` budget a ``3f+1`` cluster promises to
@@ -62,6 +68,7 @@ STEP_KINDS = (
     "route_flap",
     "sidecar_crash",
     "overload",
+    "region_partition",
 )
 
 
@@ -150,7 +157,41 @@ _BUNDLE_OK_KINDS: dict[str, set] = {
     "crash_restart": {"member_down"},
     "slow_node": {"fault", "gray_member"},
     "overload": {"resource_saturated"},
+    # Probes observe cuts (_ChaosProbeSource), so a partitioned member
+    # also transitions down at scrape time — either signal is the
+    # window's valid black-box evidence.
+    "partition": {"fault", "member_down"},
+    "region_partition": {"region_down", "member_down", "fault"},
 }
+
+
+class _ChaosProbeSource:
+    """A :class:`~bftkv_tpu.obs.source.LocalSource` whose probe also
+    crosses the failpoint plane.  In-process partitions never
+    unregister a transport, so the stock registration check would call
+    a fully cut-off member healthy — but a real external health
+    checker's probe RPC would be dropped by the same rule that drops
+    everyone else's traffic.  The probe asks the registry the same
+    question side-effect-free (:meth:`FaultRegistry.would_drop`): no
+    rule budgets consumed, no fault-trace echo, no perturbed seeded
+    draws.  Probes carry no region label, so they count as
+    outside-the-boundary traffic for a region cut and never match the
+    WAN topology's delay rules."""
+
+    def __init__(self, inner, registry: fp.FaultRegistry):
+        self._inner = inner
+        self._registry = registry
+        self.name = inner.name
+
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+    def probe(self) -> bool:
+        if not self._inner.probe():
+            return False
+        return not self._registry.would_drop(
+            "transport.send", src="fleet", dst=self.name, cmd="probe"
+        )
 
 
 class Nemesis:
@@ -161,10 +202,21 @@ class Nemesis:
         registry: fp.FaultRegistry | None = None,
         autopilot: bool = False,
         sidecar_ctl: SidecarHarness | None = None,
+        rtt_spec: str | None = None,
     ):
         self.cluster = cluster
         self.seed = seed
         self.registry = registry or fp.registry
+        #: WAN link-delay program (``--rtt-matrix``): compiled onto
+        #: quiet background delay rules right after :meth:`run` arms
+        #: the registry, so the whole schedule executes under the
+        #: deployment geography (DESIGN.md §21).
+        self.rtt_spec = rtt_spec
+        self.wan = None
+        #: region_partition windows where a write failed: an eligible
+        #: region's outage stays inside every plane's node-level f
+        #: budget by construction, so writes may slow, never fail.
+        self.region_blocked: list[dict] = []
         #: Embedded crypto sidecar under test (``--sidecar``): enables
         #: the sidecar_crash step kind and its zero-failed-writes
         #: oracle.
@@ -214,6 +266,57 @@ class Nemesis:
         self._last_direct_var: bytes | None = None
 
     # -- deterministic planning -------------------------------------------
+
+    def _region_pool(self) -> list[str]:
+        """Regions eligible for a whole-region outage.  The two-level
+        budget (DESIGN.md §21) must keep writes alive, so a region
+        qualifies only when it holds no client or gateway identities
+        and its seats stay within the NODE-level budget of every
+        plane: at most ``f`` members of each shard clique and at most
+        ``f`` storage replicas.  Empty when no region map is installed
+        — plan() then degrades the kind to a plain partition."""
+        uni = getattr(self.cluster, "universe", None)
+        rmap = getattr(uni, "regions", None) or {}
+        if not rmap:
+            return []
+
+        def reg(name: str) -> str | None:
+            return rmap.get(name)
+
+        barred = {
+            reg(i.name)
+            for i in list(getattr(uni, "users", ()))
+            + list(getattr(uni, "gateways", ()))
+        }
+        clique_groups = [
+            [i.name for i in g]
+            for g in (getattr(uni, "shards", None) or [])
+            if g
+        ] or [[i.name for i in getattr(uni, "servers", ())]]
+        storage = [i.name for i in getattr(uni, "storage_nodes", ())]
+        out = []
+        labels = sorted(
+            {
+                r
+                for k, r in rmap.items()
+                if "://" not in k and ":" not in k
+            }
+        )
+        for r in labels:
+            if r in barred:
+                continue
+            ok = all(
+                sum(1 for n in g if reg(n) == r) <= (len(g) - 1) // 3
+                for g in clique_groups
+            )
+            if ok and storage:
+                ok = (
+                    sum(1 for n in storage if reg(n) == r)
+                    <= self.cluster.f
+                )
+            if ok:
+                out.append(r)
+        return out
 
     def plan(self, steps: int = 4, kinds: tuple | None = None) -> list[dict]:
         """Pure function of (seed, cluster shape): the schedule replays
@@ -275,6 +378,7 @@ class Nemesis:
                 getattr(self.cluster, "clients", []) or []
             )
         ) or ["u01"]
+        region_pool = self._region_pool()
         out = []
         for i in range(steps):
             kind = kinds[rng.randrange(len(kinds))]
@@ -288,7 +392,14 @@ class Nemesis:
                 # No admission-bearing component (no sidecar, no
                 # gateways): nothing to clamp — degrade, same rule.
                 kind = "partition"
-            if kind == "overload":
+            if kind == "region_partition" and not region_pool:
+                # No eligible region (map not installed, or every
+                # region hosts clients/gateways or exceeds a plane's
+                # node-level f): degrade, same rule as route_flap.
+                kind = "partition"
+            if kind == "region_partition":
+                pool = region_pool
+            elif kind == "overload":
                 pool = [self._overload_queue()[1]]
             elif kind == "sidecar_crash":
                 pool = ["sidecar01"]
@@ -335,6 +446,30 @@ class Nemesis:
                 "drop",
                 match=cut,
                 rule_id=rule_id or f"partition:{name}",
+            )
+        ]
+
+    def region_partition(
+        self, region: str, rule_id: str = ""
+    ) -> list[fp.Rule]:
+        """Whole-region WAN outage: every link CROSSING the region
+        boundary is cut, both directions, while intra-region links
+        stay up — a region loses its egress, not its LAN.  Fleet
+        probes carry no region label, so they count as outside traffic
+        and observe the cut like any external health checker."""
+        from bftkv_tpu import regions as rg
+
+        def cut(ctx: dict) -> bool:
+            a = rg.region_of(ctx.get("src") or "")
+            b = rg.region_of(ctx.get("dst") or "")
+            return (a == region) != (b == region)
+
+        return [
+            self.registry.add(
+                "transport.send",
+                "drop",
+                match=cut,
+                rule_id=rule_id or f"region_partition:{region}",
             )
         ]
 
@@ -653,13 +788,23 @@ class Nemesis:
 
         sources = [
             # server_named resolves through _by_name, so a source keeps
-            # following its member across crash-restarts.
-            LocalSource(name, lambda n=name: self.cluster.server_named(n))
+            # following its member across crash-restarts.  Every probe
+            # is wrapped to observe armed drop rules (in-process cuts
+            # never unregister a transport).
+            _ChaosProbeSource(
+                LocalSource(
+                    name, lambda n=name: self.cluster.server_named(n)
+                ),
+                self.registry,
+            )
             for name in sorted(self.cluster._by_name)
         ]
         for gw in getattr(self.cluster, "gateways", ()):
             sources.append(
-                LocalSource(gw.self_node.name, lambda gw=gw: gw)
+                _ChaosProbeSource(
+                    LocalSource(gw.self_node.name, lambda gw=gw: gw),
+                    self.registry,
+                )
             )
         return FleetCollector(
             sources,
@@ -724,6 +869,24 @@ class Nemesis:
                         and "admission" in a["detail"]
                     ):
                         return "resource_saturated"
+                return None
+            if kind == "region_partition":
+                # The outage must be named AS a region event: the
+                # region_down anomaly carries the region-level budget
+                # arithmetic (f_regions - dark < 0, DESIGN.md §21).
+                # State form: the rollup reports the region dark at
+                # scrape time — consecutive windows on one region
+                # never transition back to up in between.
+                for a in fresh:
+                    if (
+                        a["kind"] == "region_down"
+                        and a["source"] == target
+                    ):
+                        return "region_down"
+                regs = self.collector.health().get("regions") or {}
+                row = (regs.get("rows") or {}).get(target)
+                if row and row.get("dark"):
+                    return "region_down"
                 return None
             if kind == "crash_restart":
                 # The plane "sees" an outage either as a fresh
@@ -864,6 +1027,28 @@ class Nemesis:
                     time.sleep(dwell)
             finally:
                 self.heal(rules)
+        elif kind == "region_partition":
+            w0 = self.failures["write"]
+            rules = self.region_partition(target)
+            try:
+                self.traffic(tag)
+                self._observe_window(step, seq0)
+                if dwell:
+                    time.sleep(dwell)
+            finally:
+                self.heal(rules)
+            if self.failures["write"] > w0:
+                # The pool admits only regions whose seats fit every
+                # plane's node-level f, so a whole-region outage may
+                # slow writes (cross-region hedges), never fail them —
+                # the DESIGN.md §21 acceptance bar.
+                self.region_blocked.append(
+                    {
+                        "step": step["step"],
+                        "region": target,
+                        "failed_writes": self.failures["write"] - w0,
+                    }
+                )
         elif kind == "crash_restart":
             self.cluster.crash(target)
             try:
@@ -1052,9 +1237,19 @@ class Nemesis:
         # the strict one-shard-per-variable invariant.
         shard_map_before = self.cluster.shard_map()
         self.registry.arm(self.seed)
+        self.wan = None
+        if self.rtt_spec:
+            # Arm cleared the rule table; compile the deployment
+            # geography onto it FIRST — quiet background rules, so a
+            # fault rule armed later at the same point always wins and
+            # the trace/anomaly feed stays fault-only (DESIGN.md §21).
+            from bftkv_tpu.regions.topology import install_matrix
+
+            self.wan = install_matrix(self.registry, self.rtt_spec)
         self.detection = []  # a re-run must not inherit stale verdicts
         self.gray_blocked = []
         self.sidecar_blocked = []
+        self.region_blocked = []
         self.recorder_missing = []
         self._migration = None
         self.collector = self._make_collector() if detect else None
@@ -1182,6 +1377,18 @@ class Nemesis:
         return {
             "seed": self.seed,
             "shards": len(set(shard_map.values())) if shard_map else 1,
+            "regions": (
+                self.cluster.universe.regions and
+                sorted({
+                    r
+                    for k, r in self.cluster.universe.regions.items()
+                    if "://" not in k and ":" not in k
+                })
+                or None
+            ),
+            "rtt_matrix": (
+                self.wan[0].describe() if self.wan else None
+            ),
             "route_epoch": epoch_after,
             "autopilot": autopilot_doc,
             "plan": plan,
@@ -1194,6 +1401,7 @@ class Nemesis:
             "undetected": [d for d in self.detection if not d["detected"]],
             "gray_blocked": self.gray_blocked,
             "sidecar_blocked": self.sidecar_blocked,
+            "region_blocked": self.region_blocked,
             "recorder": (
                 {
                     "dir": self.recorder.dir,
@@ -1237,6 +1445,21 @@ def main(argv: list[str] | None = None) -> int:
                          "the partition/link_delay target pool, and "
                          "checker invariant 3 proves no uncertified "
                          "value was ever served through the cache")
+    ap.add_argument("--regions", type=int, default=0,
+                    help="label every principal round-robin into N "
+                         "regions and install the process region map: "
+                         "locality-aware staging gets a geography to "
+                         "rank, the fleet collector grows region rows "
+                         "with the region-level f-budget, and the "
+                         "region_partition kind becomes eligible")
+    ap.add_argument("--rtt-matrix",
+                    default=flags.raw("BFTKV_WAN_RTT_MATRIX") or "",
+                    help="WAN link-delay program (regions/topology.py): "
+                         "a named matrix (wan2, wan3) or an RTT spec in "
+                         "ms, compiled onto quiet background "
+                         "transport.send delay rules so the whole "
+                         "schedule runs under deployment geography; "
+                         "needs --regions (default: BFTKV_WAN_RTT_MATRIX)")
     ap.add_argument("--bits", type=int, default=1024)
     ap.add_argument("--dwell", type=float, default=0.0,
                     help="extra seconds to hold each fault window open")
@@ -1285,6 +1508,10 @@ def main(argv: list[str] | None = None) -> int:
         ap.error("--kinds route_flap needs --autopilot and --shards 2+")
     if kinds and "sidecar_crash" in kinds and not args.sidecar:
         ap.error("--kinds sidecar_crash needs --sidecar")
+    if kinds and "region_partition" in kinds and args.regions < 2:
+        ap.error("--kinds region_partition needs --regions 2+")
+    if args.rtt_matrix and args.regions < 2:
+        ap.error("--rtt-matrix needs --regions 2+")
 
     # The sidecar's dispatchers are process-global, so it arms BEFORE
     # the cluster boots: every server's share issuance and collective
@@ -1313,11 +1540,12 @@ def main(argv: list[str] | None = None) -> int:
     cluster = build_cluster(
         args.servers, 1, args.rw, bits=args.bits, n_shards=args.shards,
         n_gateways=args.gateways, storage_factory=storage_factory,
+        n_regions=args.regions,
     )
     try:
         report = Nemesis(
             cluster, seed=args.seed, autopilot=args.autopilot,
-            sidecar_ctl=sidecar_ctl,
+            sidecar_ctl=sidecar_ctl, rtt_spec=args.rtt_matrix or None,
         ).run(
             steps=args.steps, dwell=args.dwell,
             detect=not args.no_detect, kinds=kinds,
@@ -1350,6 +1578,7 @@ def main(argv: list[str] | None = None) -> int:
         or report["undetected"]
         or report["gray_blocked"]
         or report["sidecar_blocked"]
+        or report["region_blocked"]
         or report["recorder_missing"]
         or lockwatch_msg
     )
@@ -1398,6 +1627,12 @@ def main(argv: list[str] | None = None) -> int:
             f"{s['failed_writes']} write(s) — a dead crypto sidecar "
             "must degrade to local crypto, never block a write"
         )
+    for r in report["region_blocked"]:
+        print(
+            f"REGION BLOCKED: step {r['step']} region_partition on "
+            f"{r['region']} failed {r['failed_writes']} write(s) — an "
+            "in-budget whole-region outage must never block a write"
+        )
     if report.get("recorder"):
         r = report["recorder"]
         print(
@@ -1425,6 +1660,9 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     if report["sidecar_blocked"]:
         print("nemesis: SIDECAR DEATH BLOCKED WRITES")
+        return 1
+    if report["region_blocked"]:
+        print("nemesis: REGION OUTAGE BLOCKED WRITES")
         return 1
     if report["recorder_missing"]:
         print("nemesis: FAULT WINDOWS WITHOUT A FLIGHT-RECORDER BUNDLE")

@@ -149,6 +149,33 @@ _flag("BFTKV_INLINE_FANOUT", "auto", "str",
       "`auto` runs loopback multicast inline when calibration says "
       "all-host; `off`/`on` force the threaded/inline path.")
 
+_begin("Multi-region WAN")
+_flag("BFTKV_REGION", None, "str",
+      "This process's own region label, overriding the installed "
+      "region map (a gateway box pinned to its serving region; "
+      "unset: the identity's label from the universe's regions "
+      "file).")
+_flag("BFTKV_REGION_RANK", "on", "switch",
+      "Locality-aware quorum staging: staged waves order candidates "
+      "same-region-first (then by RTT matrix distance) so the minimal "
+      "sufficient prefix is the near one and cross-region members are "
+      "hedges, not the first ask.  Never changes which sets satisfy "
+      "is_threshold/is_sufficient (DESIGN.md §21).")
+_flag("BFTKV_REGION_LEASE_S", "0", "float",
+      "Gateway freshness lease in seconds: while the last sync-"
+      "invalidation round completed this recently, TTL-expired cache "
+      "entries may still be served same-region (staleness bounded by "
+      "lease + poll interval; 0 disables — DESIGN.md §21).")
+_flag("BFTKV_WAN_RTT_MATRIX", None, "str",
+      "Named geo-topology (wan2, wan3) or raw ms spec (e.g. "
+      "20/80/150) compiled onto the link plane as quiet background "
+      "delay rules — the deterministic WAN environment for benches "
+      "and chaos soaks.")
+_flag("BFTKV_WAN_JITTER", "0", "float",
+      "Fractional jitter on WAN link delays: each one-way delay "
+      "stretches uniformly (seeded per-rule draw) up to "
+      "delay x (1 + jitter).")
+
 _begin("Crypto & verification")
 _flag("BFTKV_VERIFY_CACHE", "1", "switch",
       "Process-global verified-signature memo (`0` disables).")

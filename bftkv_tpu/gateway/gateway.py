@@ -29,8 +29,10 @@ import logging
 import threading
 import time
 
+from bftkv_tpu import flags
 from bftkv_tpu import packet as pkt
 from bftkv_tpu import quorum as qm
+from bftkv_tpu import regions as rg
 from bftkv_tpu import trace
 from bftkv_tpu import transport as tp
 from bftkv_tpu.errors import (
@@ -101,6 +103,17 @@ class Gateway:
         #: them prefer a stale-but-certified cache entry over a fill
         #: that would pile onto a struggling quorum.
         self._degraded_shards: set = set()
+        # Region-local read tier (DESIGN.md §21): a freshness lease
+        # bounds how stale a same-region certified-cache read can be.
+        # While the last sync-invalidation round completed recently
+        # (every shard group answered its digest poll within
+        # BFTKV_REGION_LEASE_S), TTL-expired entries may still be
+        # served: every survivor was confirmed unchanged (or dropped)
+        # at that poll, so staleness is bounded by TTL + lease + one
+        # poll RTT instead of forcing a cross-region quorum fill.
+        self._lease_s = flags.get_float("BFTKV_REGION_LEASE_S") or 0.0
+        self._lease_until = 0.0
+        self._lease_served = 0
         # Anti-entropy invalidation state: per-peer last-seen digest +
         # a STICKY peer cursor per shard group (a digest only means
         # something diffed against the SAME peer's previous one, so the
@@ -238,6 +251,16 @@ class Gateway:
             self._hits += 1
             metrics.incr("gateway.cache.hits")
             return ent.record
+        if self._lease_s > 0.0 and time.monotonic() < self._lease_until:
+            # Live freshness lease: a TTL-expired entry that survived
+            # the last complete digest poll is still within the §21
+            # staleness bound — serve it at cache latency instead of
+            # paying a (possibly cross-region) quorum fill.
+            leased = self.cache.get(variable, allow_stale=True)
+            if leased is not None:
+                self._lease_served += 1
+                metrics.incr("gateway.cache.lease_served")
+                return leased.record
         self._misses += 1
         metrics.incr("gateway.cache.misses")
         # Single-flight: concurrent misses on one hot key ride the
@@ -408,8 +431,10 @@ class Gateway:
         the backstop; this shortens the staleness window to ~one poll
         interval for write traffic the gateway never carried itself."""
         dropped = 0
+        groups = self._sync_groups()
+        polled_ok = bool(groups)
         for key, peers in sorted(
-            self._sync_groups().items(), key=lambda kv: str(kv[0])
+            groups.items(), key=lambda kv: str(kv[0])
         ):
             cursor = self._sync_cursor.setdefault(key, 0)
             peer = peers[cursor % len(peers)]
@@ -423,11 +448,13 @@ class Gateway:
             res = box.get("res")
             if res is None or res.err is not None or res.data is None:
                 self._sync_cursor[key] = cursor + 1  # dead: move on
+                polled_ok = False
                 continue
             try:
                 theirs = pkt.parse_digest(res.data)
             except Exception:
                 self._sync_cursor[key] = cursor + 1
+                polled_ok = False
                 continue
             prev = self._digests.get(peer.id)
             self._digests[peer.id] = theirs
@@ -443,6 +470,12 @@ class Gateway:
             )
         if dropped:
             metrics.incr("gateway.cache.sync_invalidated", dropped)
+        if self._lease_s > 0.0 and polled_ok:
+            # Every shard group answered: surviving cache entries were
+            # confirmed unchanged (changed buckets just dropped), so
+            # the freshness lease renews.  A failed poll lets the lease
+            # lapse — stale serving must never outrun the digest plane.
+            self._lease_until = time.monotonic() + self._lease_s
         return dropped
 
     def start_sync_invalidation(self, interval: float = 5.0) -> None:
@@ -477,8 +510,14 @@ class Gateway:
             "role": "gateway",
             "shard": None,
             "clique": None,
+            "region": rg.self_region(getattr(g, "name", None)),
             "gateway": {
                 **self.cache.stats(),
+                "lease_served": self._lease_served,
+                "lease_live": (
+                    self._lease_s > 0.0
+                    and time.monotonic() < self._lease_until
+                ),
                 # Per-INSTANCE counters: several gateways in one
                 # process share the metrics registry, so snapshot
                 # totals would report the whole tier as each member.

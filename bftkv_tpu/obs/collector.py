@@ -206,6 +206,9 @@ class FleetCollector:
         self.capacity = CapacityPlane()
         self._local_ring_dropped = 0
         self._local_slow_dropped = 0
+        #: Regions currently dark (every labeled member down) — the
+        #: region_down/region_up transition state (DESIGN.md §21).
+        self._region_dark: set = set()
         #: Anomaly listeners (the flight recorder's feed), called
         #: OUTSIDE the collector lock — a listener may read health().
         self._listeners: list = []
@@ -595,6 +598,11 @@ class FleetCollector:
                     f"{ev.point}:{ev.rule_id}:{ev.kind}",
                 )
 
+        # Region plane (DESIGN.md §21): a whole region going dark is a
+        # different animal than f scattered members — judge it after
+        # every member's probe verdict landed this scrape.
+        self._region_check()
+
         # Diagnosis tier (DESIGN.md §18): attribute every trace whose
         # root has waited one full scrape, then judge the SLO burn rate
         # on this scrape's delta — both AFTER every feed was ingested.
@@ -666,6 +674,102 @@ class FleetCollector:
                 **(m.info.get("gateway") or {}),
             }
         return out
+
+    @staticmethod
+    def _region_groups(members: dict) -> dict:
+        """Region label -> [(name, member)] over every member whose
+        /info carried a ``region`` seat field.  Empty on loopback
+        fleets (no region map installed) — the whole region plane then
+        stays invisible, bit-for-bit pre-region behavior."""
+        groups: dict = {}
+        for name, m in members.items():
+            r = (m.info or {}).get("region")
+            if r is None:
+                continue
+            groups.setdefault(r, []).append((name, m))
+        return groups
+
+    def _region_check(self) -> None:
+        """Emit ``region_down`` when EVERY member of a region fails its
+        probe (``region_up`` on recovery).  The region plane has its own
+        two-level budget (DESIGN.md §21): node-level, a region whose
+        clique seats stay within each shard's ``f`` leaves writes alive;
+        region-level, ``f_regions = (n_regions-1)//3`` whole-region
+        losses are masked — which is 0 below four regions, so ANY
+        whole-region outage drives the region budget negative and the
+        anomaly names that arithmetic even while zero writes fail."""
+        with self._lock:
+            members = dict(self.members)
+        groups = self._region_groups(members)
+        if not groups:
+            return
+        f_regions = (len(groups) - 1) // 3
+        for r, mem in sorted(groups.items()):
+            dark = all(m.status == "down" for _n, m in mem)
+            was = r in self._region_dark
+            if dark and not was:
+                self._region_dark.add(r)
+                used = len(self._region_dark)
+                self._emit(
+                    "region_down", r, None,
+                    f"all {len(mem)} members of region {r} dark; "
+                    f"region f-budget {f_regions}-{used}="
+                    f"{f_regions - used}",
+                )
+            elif was and not dark:
+                self._region_dark.discard(r)
+                self._emit(
+                    "region_up", r, None,
+                    f"{sum(1 for _n, m in mem if m.status == 'up')}"
+                    f"/{len(mem)} members back",
+                )
+
+    def _regions(self, members: dict, now: float) -> dict:
+        """The WAN plane's health rows (DESIGN.md §21): per-region
+        member/up/down rollup plus the REGION-LEVEL f-budget —
+        ``f_regions = (n_regions-1)//3`` whole-region outages masked,
+        so three regions budget 0 and one dark region reads -1.
+        Empty dict when no member carries a region seat."""
+        groups = self._region_groups(members)
+        if not groups:
+            return {}
+        f_regions = (len(groups) - 1) // 3
+        dark = sorted(
+            r for r, mem in groups.items()
+            if all(m.status == "down" for _n, m in mem)
+        )
+        rows: dict = {}
+        for r, mem in sorted(groups.items()):
+            down = sorted(n for n, m in mem if m.status == "down")
+            shards = sorted(
+                {
+                    m.info.get("shard")
+                    for _n, m in mem
+                    if m.info.get("shard") is not None
+                },
+                key=str,
+            )
+            rows[r] = {
+                "members": len(mem),
+                "up": len(mem) - len(down),
+                "down": down,
+                "dark": r in dark,
+                "shards": shards,
+                "gateways": sorted(
+                    n for n, m in mem
+                    if m.info.get("role") == "gateway"
+                ),
+            }
+        return {
+            "n": len(groups),
+            "f_budget": {
+                "f": f_regions,
+                "used": len(dark),
+                "remaining": f_regions - len(dark),
+                "dark": dark,
+            },
+            "rows": rows,
+        }
 
     def _sidecars(self, members: dict, now: float) -> dict:
         """The shared crypto service's health rows: status + the
@@ -837,6 +941,7 @@ class FleetCollector:
                 ),
             },
             "shards": shards_doc,
+            "regions": self._regions(all_members, now),
             "gateways": self._gateways(all_members, now),
             "sidecars": self._sidecars(all_members, now),
             "traces": {
@@ -886,6 +991,15 @@ class FleetCollector:
                     if isinstance(g.get(field), (int, float)):
                         add(f"gateway_{field}", "gauge", lab,
                             str(g[field]))
+        regs = doc.get("regions") or {}
+        if regs:
+            add("regions", "gauge", "", str(regs["n"]))
+            add("region_budget_remaining", "gauge", "",
+                str(regs["f_budget"]["remaining"]))
+            for rname, row in sorted(regs["rows"].items()):
+                lab = f'{{region="{rname}"}}'
+                add("region_members", "gauge", lab, str(row["members"]))
+                add("region_members_up", "gauge", lab, str(row["up"]))
         scs = doc.get("sidecars") or {}
         if scs:
             add("sidecars_up", "gauge", "",

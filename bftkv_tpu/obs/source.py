@@ -42,6 +42,7 @@ def seat_document(qs, node_id: int) -> dict:
         "shard_count": 1,
         "role": None,
         "clique": None,
+        "region": None,
         "owned_buckets": 256,
     }
     seat_info = getattr(qs, "seat_info", None)

@@ -423,16 +423,28 @@ class Client(Protocol):
         self._health_hints = hints
 
     def _rank_nodes(self, nodes: list) -> list:
-        """Health-aware ask order: open-circuit and fleet-reported-down
-        members last, gray (recently slow) members next-to-last,
-        cold-session peers after warm ones.  The sort is stable and
-        keys on health FLAGS only (never raw latency numbers), so with
-        no health signal the quorum's own order is preserved
-        bit-for-bit — deterministic fan-outs stay deterministic.
-        Ordering only changes which members land in the minimal first
-        wave — never which thresholds the quorum requires
-        (DESIGN.md §13.3)."""
-        if len(nodes) <= 1 or not tp.hedging_enabled():
+        """Health- and locality-aware ask order: open-circuit and
+        fleet-reported-down members last, gray (recently slow) members
+        next-to-last, then — inside each health class — same-region
+        members before cross-region ones (by RTT-matrix distance when
+        one is installed; DESIGN.md §21), cold-session peers after
+        warm ones.  The sort is stable and keys on health flags and
+        region labels only (never raw latency samples), so with no
+        health signal and no region map the quorum's own order is
+        preserved bit-for-bit — deterministic fan-outs stay
+        deterministic.  Ordering only changes which members land in
+        the minimal first wave — never which thresholds the quorum
+        requires (DESIGN.md §13.3)."""
+        from bftkv_tpu import regions as rg
+
+        own = None
+        if rg.regionmap.installed() and flags.enabled(
+            "BFTKV_REGION_RANK"
+        ):
+            own = rg.self_region(getattr(self.self_node, "name", None))
+        if len(nodes) <= 1 or not (
+            tp.hedging_enabled() or own is not None
+        ):
             return list(nodes)
         msg = getattr(getattr(self.tr, "security", None), "message", None)
         has_session = getattr(msg, "has_session", None)
@@ -445,8 +457,15 @@ class Client(Protocol):
                 hints.get(getattr(n, "name", ""), "") == "down"
             )
             cold = has_session is not None and not has_session(n.id)
+            loc = 0.0
+            if own is not None:
+                other = rg.region_of(
+                    getattr(n, "name", None)
+                ) or rg.region_of(addr)
+                loc = rg.regionmap.rank(own, other)
             return (
                 2 if down else (1 if plat.is_gray(addr) else 0),
+                loc,
                 cold,
             )
 

@@ -844,6 +844,9 @@ class WotQS:
         nsh = len(topo.shards)
         mine = topo.shard_index_of(node_id)
         r = self._routing(topo)
+        inst = getattr(self.g.vertices.get(node_id), "instance", None)
+        from bftkv_tpu import regions as _regions
+
         out: dict = {
             "shard": (
                 mine if nsh > 1 else (0 if mine is not None else None)
@@ -851,6 +854,9 @@ class WotQS:
             "shard_count": max(nsh, 1),
             "role": None,
             "clique": None,
+            # Deployment-plane region label (DESIGN.md §21): resolved
+            # from the process region map, never from the certificate.
+            "region": _regions.region_of(getattr(inst, "name", None)),
             "owned_buckets": ROUTE_BUCKETS,
             # Epoched routing: the installed route-table epoch (0 =
             # pure HRW) and the dual-window width — the fleet plane's

@@ -50,11 +50,18 @@ __all__ = [
 
 @dataclass
 class Identity:
-    """One principal: private key + its certificate."""
+    """One principal: private key + its certificate.
+
+    ``region`` is deployment-plane metadata (DESIGN.md §21): never
+    serialized into the certificate wire format (the TOFU-pinned uid
+    and BCR frames are untouched), persisted instead via the home
+    directory's ``regions`` file and the process-global
+    :mod:`bftkv_tpu.regions` map."""
 
     name: str
     key: object  # rsa.PrivateKey | ecdsa.ECPrivateKey
     cert: certmod.Certificate
+    region: str | None = None
 
     @property
     def id(self) -> int:
@@ -133,6 +140,10 @@ class Universe:
     # plane.  Dial addresses are deployment config: ``gateway_addrs``.
     gateways: list[Identity] = field(default_factory=list)
     gateway_addrs: dict[str, str] = field(default_factory=dict)
+    # Region labels (``n_regions``): name → region AND address →
+    # region for every labeled principal — the exact mapping
+    # :func:`bftkv_tpu.regions.install` takes.  Empty = single-region.
+    regions: dict[str, str] = field(default_factory=dict)
 
     @property
     def all(self) -> list[Identity]:
@@ -200,6 +211,7 @@ def build_universe(
     n_shards: int = 1,
     n_gateways: int = 0,
     gw_base_port: int = 6201,
+    n_regions: int = 0,
 ) -> Universe:
     """The canonical test topology (reference: scripts/setup.sh:17-48).
 
@@ -220,6 +232,15 @@ def build_universe(
     servers, so one client identity carries a valid quorum certificate
     at every clique.  ``n_shards=1`` is byte-compatible with the
     pre-sharding topology.
+
+    ``n_regions``: region labels (DESIGN.md §21) — every plane's
+    principals are assigned round-robin to ``r0..r{n_regions-1}``
+    (clique member i → ``r{i % n_regions}``, same for storage, users
+    and gateways), so each shard's seats spread across regions the way
+    a geo-replicated deployment would place them.  Labels land on the
+    identities (``Identity.region``) and in ``Universe.regions``
+    (name → region and address → region), never in the certificate
+    wire format.  0 = unlabeled (the loopback world).
 
     ``n_gateways``: edge gateway identities (gw01..) — user-shaped
     trust (quorum-certified, sign the servers in their own views) with
@@ -312,6 +333,31 @@ def build_universe(
             sign(s, g)  # quorum certificate, like any signed user
         gateways.append(g)
 
+    regions_map: dict[str, str] = {}
+    if n_regions:
+        if n_regions < 1:
+            raise ValueError("n_regions must be >= 0")
+
+        def label(i: int) -> str:
+            return f"r{i % n_regions}"
+
+        for group in shards:
+            for i, ident in enumerate(group):
+                ident.region = label(i)
+        for plane in (storage_nodes, users, gateways):
+            for i, ident in enumerate(plane):
+                ident.region = label(i)
+        for ident in servers + storage_nodes + users + gateways:
+            if ident.region is None:
+                continue
+            regions_map[ident.name] = ident.region
+            if ident.cert.address:
+                regions_map[ident.cert.address] = ident.region
+        for name, a in gateway_addrs.items():
+            r = regions_map.get(name)
+            if r:
+                regions_map[a] = r
+
     return Universe(
         servers=servers,
         storage_nodes=storage_nodes,
@@ -321,6 +367,7 @@ def build_universe(
         shards=shards,
         gateways=gateways,
         gateway_addrs=gateway_addrs,
+        regions=regions_map,
     )
 
 
@@ -329,6 +376,7 @@ def save_home(
     identity: Identity,
     view: list[certmod.Certificate],
     local_trust: list[int] | None = None,
+    regions: dict[str, str] | None = None,
 ) -> None:
     """Persist one principal's home directory: ``pubring`` (its whole
     certificate view) + ``secring`` (its private key) — the layout the
@@ -337,7 +385,13 @@ def save_home(
 
     ``local_trust``: ids for local-only graph edges (``localtrust``
     file, one hex id per line) — applied by :func:`load_home`, never
-    serialized into certificates."""
+    serialized into certificates.
+
+    ``regions``: the universe's region labels (``Universe.regions``)
+    — a ``regions`` file of ``<name-or-address> <region>`` lines,
+    merged into the process-global region map by :func:`load_home`
+    (the localtrust pattern: deployment metadata beside the keyring,
+    never inside the certificates)."""
     import os
 
     from bftkv_tpu.crypto.keyring import Keyring
@@ -354,6 +408,13 @@ def save_home(
     if local_trust:
         with open(os.path.join(path, "localtrust"), "w") as f:
             f.write("".join(f"{i:016x}\n" for i in local_trust))
+    if regions:
+        with open(os.path.join(path, "regions"), "w") as f:
+            f.write(
+                "".join(
+                    f"{k} {r}\n" for k, r in sorted(regions.items())
+                )
+            )
 
 
 def load_home(path: str):
@@ -390,6 +451,18 @@ def load_home(path: str):
         with open(lt) as f:
             ids = [int(line, 16) for line in f if line.strip()]
         graph.add_local_edges(self_cert.id, ids)
+    rf = os.path.join(path, "regions")
+    if os.path.exists(rf):
+        from bftkv_tpu import regions as _regions
+
+        labels: dict[str, str] = {}
+        with open(rf) as f:
+            for line in f:
+                parts = line.split()
+                if len(parts) == 2:
+                    labels[parts[0]] = parts[1]
+        if labels:
+            _regions.regionmap.merge(labels)
     crypt = Crypto(
         keyring=ring,
         signer=Signer(key, self_cert),

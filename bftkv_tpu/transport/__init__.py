@@ -943,14 +943,25 @@ def multicast_staged(
     next_wave = 1
     hedged_ids: set[int] = set()
     answered_hedged = 0
-    delay = peer_latency.hedge_delay(
-        [getattr(p, "address", "") or "" for p in waves[0]]
-    )
+
+    def wave_delay(w: list) -> float:
+        return peer_latency.hedge_delay(
+            [getattr(p, "address", "") or "" for p in w]
+        )
+
+    # The hedge trigger tracks the most recently LAUNCHED wave: with
+    # locality-ordered staging wave 0 is same-region, so its (small)
+    # p99 sets the trigger and a 150 ms cross-region member waiting in
+    # a later wave can never inflate it; once a cross-region wave has
+    # launched, the trigger honestly widens to that wave's own p99
+    # (DESIGN.md §21).
+    delay = wave_delay(waves[0])
     while outstanding > 0 or (next_wave < len(waves) and need_more()):
         if outstanding == 0:
             stats["expanded"] = True  # classic shortfall expansion
             launch(offsets[next_wave], waves[next_wave])
             outstanding += len(waves[next_wave])
+            delay = max(delay, wave_delay(waves[next_wave]))
             next_wave += 1
             continue
         can_hedge = next_wave < len(waves) and need_more()
@@ -968,6 +979,7 @@ def multicast_staged(
                 "transport.hedge.sent", len(w), labels={"cmd": name}
             )
             outstanding += len(w)
+            delay = max(delay, wave_delay(w))
             next_wave += 1
             continue
         outstanding -= 1

@@ -26,7 +26,9 @@ timeout):
   it fires early where the deadline fires late.
 - **gray flag** — a sample far above the peer's own p50, OR a p50
   persistently above the fleet's (3x the median of the OTHER peers'
-  p50s), marks the peer gray for ``GRAY_SECS`` (and bumps
+  p50s, compared within the peer's region class only — geography is
+  not grayness, DESIGN.md §21), marks the peer gray for ``GRAY_SECS``
+  (and bumps
   ``transport.peer.slow``, which the fleet collector turns into a
   ``gray_member`` anomaly).  Health-aware staging reads this flag to
   push gray peers out of the first wave.  The fleet-relative clause is
@@ -202,13 +204,25 @@ class PeerLatency:
             )
 
     def _fleet_baseline_locked(self, exclude: str) -> float | None:
-        """Median of the OTHER warmed-up peers' p50s — the fleet's idea
-        of a normal RTT, against which a persistently shifted peer is
-        judged.  None when fewer than one other peer has history."""
+        """Median of the OTHER warmed-up peers' p50s **within the
+        excluded peer's region class** — the fleet's idea of a normal
+        RTT for peers at that distance, against which a persistently
+        shifted peer is judged.  The region restriction is what makes
+        gray detection WAN-correct: under an RTT matrix every
+        cross-region peer's p50 legitimately sits multiples above the
+        near peers' median, and a whole-fleet baseline would flag all
+        of geography as gray (DESIGN.md §21).  With no region map
+        every peer shares one class (None) and the clause behaves
+        exactly as before.  None when fewer than one comparable other
+        peer has history."""
+        from bftkv_tpu import regions as rg
+
+        cls = rg.region_of(exclude)
         p50s = [
             q
             for a, p in self._peers.items()
             if a != exclude
+            and rg.region_of(a) == cls
             and p.samples >= 4
             and (q := self._quantile_locked(p, 0.5)) is not None
         ]

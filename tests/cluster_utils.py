@@ -56,6 +56,12 @@ class Cluster:
             gw.stop()
         for s in self.all_servers:
             s.tr.stop()
+        if self.universe.regions:
+            # The region map is process-global: a labeled cluster must
+            # not leak its geography into the next test's fleet.
+            from bftkv_tpu import regions
+
+            regions.clear()
 
     def server_named(self, name: str) -> Server:
         idents = self.universe.servers + self.universe.storage_nodes
@@ -80,13 +86,16 @@ def start_cluster(
     alg: str = "rsa",
     n_shards: int = 1,
     n_gateways: int = 0,
+    n_regions: int = 0,
 ) -> Cluster:
     """``transport="loop"`` wires the in-process loopback net;
     ``transport="http"`` starts every server on a real localhost HTTP
     port — the reference's tier-3 shape (protocol/test_utils.go:24-82,
     one process, loopback sockets).  ``n_shards`` builds that many
     disjoint server cliques (``n_servers``/``n_rw`` become per-shard
-    counts — see topology.build_universe)."""
+    counts — see topology.build_universe).  ``n_regions`` labels every
+    principal round-robin and installs the process-global region map
+    (cleared again by :meth:`Cluster.stop`)."""
     if transport == "http":
         http_cls = TrHTTP if transport_cls is TrLoopback else transport_cls
         if not (isinstance(http_cls, type) and issubclass(http_cls, TrHTTP)):
@@ -99,6 +108,7 @@ def start_cluster(
             base_port=base, rw_base_port=base + 50,
             unsigned_users=unsigned_users, alg=alg, n_shards=n_shards,
             n_gateways=n_gateways, gw_base_port=base + 80,
+            n_regions=n_regions,
         )
         net = None
         make_tr = lambda crypt: http_cls(crypt)
@@ -106,10 +116,14 @@ def start_cluster(
         uni = topology.build_universe(
             n_servers, n_users, n_rw, scheme="loop", bits=bits,
             unsigned_users=unsigned_users, alg=alg, n_shards=n_shards,
-            n_gateways=n_gateways,
+            n_gateways=n_gateways, n_regions=n_regions,
         )
         net = LoopbackNet()
         make_tr = lambda crypt: transport_cls(crypt, net)
+    if uni.regions:
+        from bftkv_tpu import regions
+
+        regions.install(uni.regions)
     cluster = Cluster(universe=uni, net=net)
     for ident in uni.servers + uni.storage_nodes:
         graph, crypt, qs = topology.make_node(ident, uni.view_of(ident))
@@ -121,7 +135,13 @@ def start_cluster(
             cluster.storage_servers.append(srv)
     for ident in uni.users:
         graph, crypt, qs = topology.make_node(ident, uni.view_of(ident))
-        cluster.clients.append(client_cls(graph, qs, make_tr(crypt), crypt))
+        tr = make_tr(crypt)
+        # Clients are partitionable links too (the chaos-harness
+        # idiom): without a link id the failpoint ctx posts src="" and
+        # a region-keyed rule (WAN delay, region cut) can never match
+        # client-originated traffic.
+        tr.link_id = ident.name
+        cluster.clients.append(client_cls(graph, qs, tr, crypt))
     for ident in uni.gateways:
         from bftkv_tpu.gateway import Gateway
 

@@ -1,0 +1,307 @@
+"""Multi-region WAN plane (DESIGN.md §21): the region model, the RTT
+matrix grammar + link-delay program, the side-effect-free probe check
+(``would_drop``), the WAN-correct gray baseline, and the locality axis
+of health-aware staging — including the invariant that ranking can
+never change which thresholds a quorum requires.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from bftkv_tpu import quorum as qm
+from bftkv_tpu import regions as rg
+from bftkv_tpu import topology
+from bftkv_tpu import transport as tp
+from bftkv_tpu.faults import failpoint as fp
+from bftkv_tpu.regions.topology import NAMED, RttMatrix, install_matrix
+from bftkv_tpu.storage.memkv import MemStorage
+from bftkv_tpu.transport.latency import PeerLatency
+
+from cluster_utils import start_cluster
+
+BITS = 1024
+
+
+@pytest.fixture(autouse=True)
+def _clean_region_plane():
+    rg.clear()
+    yield
+    fp.disarm()
+    rg.clear()
+
+
+# -- region map -------------------------------------------------------------
+
+
+def test_empty_map_is_the_loopback_world():
+    assert not rg.regionmap.installed()
+    assert rg.region_of("a01") is None
+    # In the loopback world every lookup is None, and None-vs-None is
+    # local — region-aware sort keys collapse to a constant.
+    assert rg.regionmap.rank(None, None) == 0.0
+    assert rg.regionmap.rank("r0", None) == 0.0
+    assert rg.regionmap.regions() == []
+
+
+def test_install_indexes_names_and_link_ids():
+    rg.install({"a01": "r0", "http://127.0.0.1:6001": "r1"})
+    assert rg.region_of("a01") == "r0"
+    # Address resolves in every form: verbatim, bare link id, and a
+    # differently-pathed URL collapsing to the same link.
+    assert rg.region_of("http://127.0.0.1:6001") == "r1"
+    assert rg.region_of("127.0.0.1:6001") == "r1"
+    assert rg.region_of("http://127.0.0.1:6001/path") == "r1"
+    assert rg.region_of("unknown") is None
+    assert rg.region_of(None) is None
+
+
+def test_members_excludes_link_aliases():
+    rg.install({"a01": "r0", "loop://a01": "r0", "a02": "r1"})
+    assert rg.regionmap.members("r0") == ["a01"]
+    assert rg.regionmap.regions() == ["r0", "r1"]
+
+
+def test_rank_orders_by_rtt_when_matrix_installed():
+    rg.install({"a": "r0", "b": "r1", "c": "r2"})
+    assert rg.regionmap.rank("r0", "r0") == 0.0
+    assert rg.regionmap.rank("r0", "r1") == 1.0  # no matrix: flat
+    m = RttMatrix.parse("20/80/150", ["r0", "r1", "r2"])
+    rg.regionmap.set_rtt(m)
+    assert rg.regionmap.rank("r0", "r1") == pytest.approx(0.020)
+    assert rg.regionmap.rank("r0", "r2") == pytest.approx(0.080)
+    assert rg.regionmap.rank("r0", None) == 0.0  # unlabeled: local
+
+
+# -- rtt matrix grammar -----------------------------------------------------
+
+
+def test_matrix_pairwise_spec():
+    m = RttMatrix.parse("20/80/150", ["r2", "r0", "r1"])  # unsorted in
+    assert m.regions == ["r0", "r1", "r2"]
+    assert m.intra_s == 0.0
+    assert m.rtt("r0", "r1") == pytest.approx(0.020)
+    assert m.rtt("r2", "r0") == pytest.approx(0.080)  # symmetric
+    assert m.rtt("r1", "r2") == pytest.approx(0.150)
+    assert m.min_cross_s() == pytest.approx(0.020)
+    assert m.max_cross_s() == pytest.approx(0.150)
+
+
+def test_matrix_intra_plus_pairwise_spec_and_named():
+    m = RttMatrix.parse("wan2", ["r0", "r1"])
+    assert NAMED["wan2"] == "20/60"
+    assert m.intra_s == pytest.approx(0.020)
+    assert m.rtt("r0", "r0") == pytest.approx(0.020)
+    assert m.rtt("r0", "r1") == pytest.approx(0.060)
+    assert m.name == "wan2"
+
+
+def test_matrix_rejects_wrong_value_count_and_small_fleets():
+    with pytest.raises(ValueError):
+        RttMatrix.parse("20/80", ["r0", "r1", "r2"])  # 3 regions: 3 or 4
+    with pytest.raises(ValueError):
+        RttMatrix.parse("20", ["r0"])  # < 2 regions
+    with pytest.raises(ValueError):
+        RttMatrix.parse("not/a/spec", ["r0", "r1", "r2"])
+
+
+# -- link-delay program + failpoint plane -----------------------------------
+
+
+def test_delay_program_is_quiet_background_and_never_shadows_faults():
+    rg.install({"a": "r0", "b": "r1"})
+    reg = fp.arm(11)
+    # One cross pair at 100 ms RTT → a 50 ms one-way rule each way.
+    matrix, program = install_matrix(reg, "100", regions=["r0", "r1"])
+    assert all(r.quiet and r.background for r in program.rules)
+    assert len(program.rules) == 2
+    act = reg._fire("transport.send", {"src": "a", "dst": "b"})
+    assert act is not None and act.kind == "delay"
+    assert act.params["seconds"] == pytest.approx(0.050)
+    # Quiet: the fired delay is an environment, not a fault event.
+    assert reg.trace() == []
+    # Intra-region and unlabeled traffic never match.
+    assert reg._fire("transport.send", {"src": "a", "dst": "a"}) is None
+    assert reg._fire("transport.send", {"src": "", "dst": "b"}) is None
+    # A foreground drop armed LATER at the same point wins the
+    # first-match dispatch over the always-matching topology rule.
+    reg.add("transport.send", "drop", match={"dst": "b"}, rule_id="cut")
+    act = reg._fire("transport.send", {"src": "a", "dst": "b"})
+    assert act is not None and act.kind == "drop"
+    # The regionmap learned the matrix for distance ranking.
+    assert rg.regionmap.rank("r0", "r1") == pytest.approx(0.100)
+    assert matrix.min_cross_s() == pytest.approx(0.100)
+
+
+def test_would_drop_is_side_effect_free_and_respects_budget():
+    reg = fp.arm(7)
+    rule = reg.add(
+        "transport.send", "drop", match={"dst": "b"}, times=1,
+        rule_id="once",
+    )
+    assert reg.would_drop("transport.send", dst="b")
+    assert not reg.would_drop("transport.send", dst="a")
+    # No side effects: budgets, draws, and the trace are untouched.
+    assert rule._evals == 0 and rule._fires == 0
+    assert reg.trace() == []
+    # A spent fire budget stops matching — the probe sees the heal.
+    assert reg._fire("transport.send", {"dst": "b"}).kind == "drop"
+    assert not reg.would_drop("transport.send", dst="b")
+    # Delay rules are not drops: geography never reads as a partition.
+    reg.add("transport.send", "delay", seconds=0.01, rule_id="slow")
+    assert not reg.would_drop("transport.send", dst="c")
+
+
+# -- WAN-correct gray detection (transport.latency) -------------------------
+
+
+def test_fleet_baseline_compares_within_region_class_only():
+    """A cross-region peer's legitimately higher p50 is geography, not
+    grayness — but a peer slow against its OWN region class still
+    flags.  This is the WAN regression the fleet-relative baseline
+    shipped with: without the class restriction every far peer sits
+    3x above the near median and all of geography turns gray."""
+    rg.install({"a": "r0", "b": "r0", "c": "r0", "z": "r1", "d": "r0"})
+    pl = PeerLatency()
+    for _ in range(6):
+        for near in ("a", "b", "c"):
+            pl.record(near, 0.010)
+    # Far peer: steady 1 s p50 — multiples above the near median, but
+    # normal for its distance.  No other r1 peer → no class baseline →
+    # only the self-relative rule applies, and a steady p50 never
+    # trips it.
+    for _ in range(6):
+        pl.record("z", 1.0)
+    assert not pl.is_gray("z")
+    # Same-region straggler: judged against its own class's 10 ms
+    # median, so its steady 1 s p50 IS persistent grayness.
+    for _ in range(6):
+        pl.record("d", 1.0)
+    assert pl.is_gray("d")
+
+
+def test_fleet_baseline_unchanged_without_region_map():
+    """No region map → one class (None) for everyone: the pre-region
+    behavior, bit-for-bit."""
+    pl = PeerLatency()
+    for _ in range(6):
+        for near in ("a", "b", "c"):
+            pl.record(near, 0.010)
+    for _ in range(6):
+        pl.record("z", 1.0)
+    assert pl.is_gray("z")
+
+
+# -- locality-aware staging -------------------------------------------------
+
+
+@pytest.fixture()
+def wan_cluster():
+    # The health singletons are process-global: scrub signals earlier
+    # tests may have left on the same loop:// addresses.
+    tp.peer_latency.reset()
+    tp.peer_health.reset()
+    c = start_cluster(
+        4, 1, 4, bits=BITS, storage_factory=MemStorage, n_regions=3
+    )
+    yield c
+    c.stop()
+    tp.peer_latency.reset()
+    tp.peer_health.reset()
+
+
+def test_rank_nodes_puts_same_region_first_and_orders_by_rtt(wan_cluster):
+    cl = wan_cluster.clients[0]  # u01 → r0
+    qa = qm.choose_quorum_for(cl.qs, b"regions/x", qm.AUTH | qm.PEER)
+    nodes = qa.nodes()
+    m = RttMatrix.parse("20/80/150", rg.regionmap.regions())
+    rg.regionmap.set_rtt(m)
+    ranked = cl._rank_nodes(nodes)
+    order = [rg.region_of(n.name) for n in ranked]
+    # Same-region members form the prefix; the tail orders by matrix
+    # distance (r1 at 20 ms before r2 at 80 ms from r0).
+    n_same = order.count("r0")
+    assert n_same >= 1
+    assert all(r == "r0" for r in order[:n_same])
+    assert order[n_same:] == ["r1", "r2"]
+
+
+def test_ranking_is_a_permutation_and_never_changes_thresholds(wan_cluster):
+    cl = wan_cluster.clients[0]
+    qa = qm.choose_quorum_for(cl.qs, b"regions/y", qm.AUTH | qm.PEER)
+    nodes = qa.nodes()
+    m = RttMatrix.parse("20/80/150", rg.regionmap.regions())
+    rg.regionmap.set_rtt(m)
+    ranked = cl._rank_nodes(nodes)
+    assert sorted(n.id for n in ranked) == sorted(n.id for n in nodes)
+    # Quorum predicates are set functions: any permutation of the same
+    # member set answers identically — ordering chooses who is ASKED
+    # first, never what the quorum REQUIRES.
+    assert qa.is_threshold(ranked) == qa.is_threshold(nodes)
+    assert qa.is_sufficient(ranked) == qa.is_sufficient(nodes)
+    for k in range(1, len(ranked) + 1):
+        prefix = ranked[:k]
+        shuffled = sorted(prefix, key=lambda n: n.id)
+        assert qa.is_sufficient(prefix) == qa.is_sufficient(shuffled)
+        assert qa.is_threshold(prefix) == qa.is_threshold(shuffled)
+
+
+def test_cross_region_members_ride_the_hedge_wave_not_the_prefix(
+    wan_cluster,
+):
+    """The staged first wave is the minimal sufficient prefix of the
+    ranked order: with two of the four clique seats local, it holds
+    both local seats plus the NEAREST cross-region one — the farthest
+    region is asked only on shortfall (the hedge/expansion path)."""
+    from bftkv_tpu.protocol.client import _staged_wave
+
+    cl = wan_cluster.clients[0]
+    qa = qm.choose_quorum_for(cl.qs, b"regions/z", qm.AUTH | qm.PEER)
+    m = RttMatrix.parse("20/80/150", rg.regionmap.regions())
+    rg.regionmap.set_rtt(m)
+    ranked = cl._rank_nodes(qa.nodes())
+    wave1, rest = _staged_wave(qa, ranked)
+    assert qa.is_sufficient(wave1)
+    assert not qa.is_sufficient(wave1[:-1])  # minimal, not padded
+    assert all(rg.region_of(n.name) != "r2" for n in wave1)
+    assert [rg.region_of(n.name) for n in rest] == ["r2"]
+
+
+def test_rank_nodes_region_axis_gated_by_flag(wan_cluster, monkeypatch):
+    monkeypatch.setenv("BFTKV_REGION_RANK", "off")
+    cl = wan_cluster.clients[0]
+    qa = qm.choose_quorum_for(cl.qs, b"regions/g", qm.AUTH | qm.PEER)
+    m = RttMatrix.parse("20/80/150", rg.regionmap.regions())
+    rg.regionmap.set_rtt(m)
+    nodes = qa.nodes()
+    ranked = cl._rank_nodes(nodes)
+    # Flag off: the locality axis is inert — with no health signal the
+    # quorum's own order is preserved bit-for-bit.
+    assert [n.id for n in ranked] == [n.id for n in nodes]
+
+
+# -- region labels across the topology plane --------------------------------
+
+
+def test_build_universe_round_robin_and_home_roundtrip(tmp_path):
+    uni = topology.build_universe(
+        4, 2, 2, bits=BITS, n_gateways=1, n_regions=3
+    )
+    assert [i.region for i in uni.servers] == ["r0", "r1", "r2", "r0"]
+    assert [i.region for i in uni.storage_nodes] == ["r0", "r1"]
+    assert [i.region for i in uni.users] == ["r0", "r1"]
+    assert [i.region for i in uni.gateways] == ["r0"]
+    # Universe.regions maps names AND addresses.
+    assert uni.regions["a02"] == "r1"
+    assert uni.regions[uni.servers[1].cert.address] == "r1"
+    # save_home writes the regions file; load_home merges it into the
+    # process-global map (the localtrust pattern).
+    ident = uni.users[0]
+    home = str(tmp_path / ident.name)
+    topology.save_home(
+        home, ident, uni.view_of(ident), regions=uni.regions
+    )
+    rg.clear()
+    topology.load_home(home)
+    assert rg.region_of("a02") == "r1"
+    assert rg.region_of(ident.name) == "r0"

@@ -1,0 +1,126 @@
+"""WAN smoke (DESIGN.md §21, the CI tier-1 step): a 2-region loopback
+fleet under the ``wan2`` matrix (20 ms intra / 60 ms cross).  Proves
+the two §21 claims cheaply: a same-region gateway read is served at
+cache latency (never paying the cross-region quorum fan-out), and the
+fleet collector's health document grows per-region rows that
+``cmd.fleet`` renders.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import Counter
+
+import pytest
+
+from bftkv_tpu import regions as rg
+from bftkv_tpu import transport as tp
+from bftkv_tpu.cmd.fleet import render as fleet_render
+from bftkv_tpu.faults import failpoint as fp
+from bftkv_tpu.obs import FleetCollector, LocalSource
+from bftkv_tpu.regions.topology import install_matrix
+from bftkv_tpu.storage.memkv import MemStorage
+
+from cluster_utils import start_cluster
+
+BITS = 1024
+
+
+@pytest.fixture(scope="module")
+def wan_fleet():
+    tp.peer_latency.reset()
+    tp.peer_health.reset()
+    cluster = start_cluster(
+        4, 2, 4, bits=BITS, storage_factory=MemStorage,
+        n_gateways=1, n_regions=2,
+    )
+    reg = fp.arm(5)
+    matrix, _program = install_matrix(reg, "wan2")
+    yield cluster, reg, matrix
+    fp.disarm()
+    cluster.stop()
+    tp.peer_latency.reset()
+    tp.peer_health.reset()
+
+
+def _p50(lats: list[float]) -> float:
+    s = sorted(lats)
+    return s[len(s) // 2]
+
+
+def test_same_region_gateway_read_at_cache_latency(wan_fleet):
+    cluster, _reg, matrix = wan_fleet
+    uni = cluster.universe
+    # Round-robin labels put reader 0 in the gateway's region and
+    # reader 1 across the 60 ms link.
+    assert uni.users[0].region == uni.gateways[0].region
+    assert uni.users[1].region != uni.gateways[0].region
+    gw_same = cluster.gateway_client(0)
+    gw_cross = cluster.gateway_client(1)
+
+    gw_same.write(b"wan/smoke", b"v1")
+    assert gw_same.read(b"wan/smoke") == b"v1"  # warm the edge cache
+
+    same, cross = [], []
+    for _ in range(9):
+        t0 = time.perf_counter()
+        assert gw_same.read(b"wan/smoke") == b"v1"
+        same.append(time.perf_counter() - t0)
+    for _ in range(5):
+        t0 = time.perf_counter()
+        assert gw_cross.read(b"wan/smoke") == b"v1"
+        cross.append(time.perf_counter() - t0)
+
+    # A cached same-region read pays one intra-region hop (~10 ms
+    # one-way under wan2); an uncached read would add the gateway's
+    # cross-region quorum fan-out (>= 30 ms more).  Cache latency is
+    # therefore anything comfortably below that fan-out floor.
+    assert _p50(same) < 0.035, f"same-region read p50 {_p50(same):.4f}s"
+    # The cross-region reader pays the 60 ms link by construction —
+    # same-region locality is what the region plane buys.
+    assert _p50(cross) > _p50(same)
+
+
+def test_region_rows_in_health_and_fleet_render(wan_fleet):
+    cluster, _reg, _matrix = wan_fleet
+    uni = cluster.universe
+    idents = uni.servers + uni.storage_nodes
+    sources = [
+        LocalSource(ident.name, lambda s=srv: s)
+        for ident, srv in zip(idents, cluster.all_servers)
+    ]
+    for gw in cluster.gateways:
+        sources.append(LocalSource(gw.self_node.name, lambda g=gw: g))
+    coll = FleetCollector(sources)
+    coll.scrape_once()
+    doc = coll.health()
+
+    regs = doc["regions"]
+    assert regs["n"] == 2
+    expected = Counter(
+        i.region for i in uni.servers + uni.storage_nodes + uni.gateways
+    )
+    assert set(regs["rows"]) == set(expected)
+    for r, row in regs["rows"].items():
+        assert row["members"] == expected[r]
+        assert row["up"] == row["members"]
+        assert row["down"] == [] and not row["dark"]
+    gw_region = uni.gateways[0].region
+    assert regs["rows"][gw_region]["gateways"] == [
+        uni.gateways[0].name
+    ]
+    # Healthy fleet: the region-level f-budget is intact and nothing
+    # in the anomaly feed names a region outage.
+    assert regs["f_budget"]["f"] == 0  # (2-1)//3 — any outage reads -1
+    assert regs["f_budget"]["remaining"] == 0
+    assert regs["f_budget"]["dark"] == []
+    assert not [
+        a for a in coll.anomalies() if a["kind"] == "region_down"
+    ]
+
+    out = fleet_render(doc)
+    assert "regions: 2" in out
+    for r, row in regs["rows"].items():
+        assert f"{r}: {row['up']}/{row['members']} up" in out
+    # The process-global map and the health rollup agree on the world.
+    assert sorted(regs["rows"]) == rg.regionmap.regions()

@@ -66,11 +66,19 @@ def _backend_class(status: str) -> str:
     cluster sections saturate threads, so numbers from boxes with
     different core counts are incomparable — reported, never gated,
     exactly like tpu-vs-cpu.  Legacy bare ``cpu`` statuses (unknown
-    core count) form their own class for the same reason."""
+    core count) form their own class for the same reason.  A ``+wan:``
+    marker (the cluster_wan section's RTT matrix, DESIGN.md §21) is
+    part of the class: geography dominates the physics, so a round
+    under a different matrix — or none — is never compared against."""
     s = (status or "").lower()
-    if not s.startswith("cpu"):
-        return "tpu"
-    return s.split()[0].split("-")[0]  # "cpu/8[-fallback]" → "cpu/8"
+    base, _, wan = s.partition("+wan:")
+    if not base.startswith("cpu"):
+        cls = "tpu"
+    else:
+        cls = base.split()[0].split("-")[0]  # "cpu/8[-fallback]" → "cpu/8"
+    if wan:
+        cls += "+wan:" + wan.split()[0].split("-")[0]
+    return cls
 
 
 def extract_sections(doc: dict) -> dict:
