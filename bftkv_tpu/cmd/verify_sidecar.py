@@ -363,8 +363,6 @@ class SidecarService:
         self.verify = dispatch.VerifyDispatcher(
             max_batch=max_batch, calibrate=False, **kw
         ).start()
-        if flags.raw("BFTKV_HOST_VERIFY_THRESHOLD") is None:
-            self.verify.verifier.host_threshold = cal["verify_crossover"]
         sign_wait = 0.0005 if host_tier else None
         # Host-tier flush bounds: a host sign is ~2 ms/item with no
         # launch to amortize, so a flush merging several tenants'
@@ -378,18 +376,13 @@ class SidecarService:
         self.sign = dispatch.SignDispatcher(
             max_batch=sign_flush, calibrate=False, max_wait=sign_wait
         ).start()
-        if host_tier and flags.raw("BFTKV_HOST_SIGN_THRESHOLD") is None:
-            self.sign.signer.host_threshold = dispatch.ALWAYS_HOST
         self.modexp = dispatch.ModexpDispatcher(
             max_batch=sign_flush,
             calibrate=False,
-            device_threshold=(
-                dispatch.ALWAYS_HOST
-                if host_tier
-                else max(16, cal["verify_crossover"])
-            ),
             **kw,
         ).start()
+        self._cal: dict = {}
+        self.apply_calibration(cal)
         self.admission = admission or AdmissionQueue(
             max_inflight=flags.get_int("BFTKV_SIDECAR_MAX_INFLIGHT"),
             max_queue=flags.get_int("BFTKV_SIDECAR_MAX_QUEUE"),
@@ -398,8 +391,84 @@ class SidecarService:
         )
         self.max_keys = flags.get_int("BFTKV_SIDECAR_MAX_KEYS")
         self._t0 = time.monotonic()
+        # Online recalibration (ISSUE 19): the boot verdict above used
+        # to be forever — nothing ever called calibration(force=True)
+        # again, so an accelerator attached (or un-wedged) mid-run
+        # could not flip ALWAYS_HOST without a restart.  The loop
+        # re-measures every BFTKV_DISPATCH_RECAL_S seconds, and
+        # immediately after the FIRST accelerator-backed launch
+        # completes (observed_launch_rtt turns non-None).
+        self._recal_stop = threading.Event()
+        self._recal_seen_rtt = False
+        self._recal_thread: threading.Thread | None = None
+        period = flags.get_float("BFTKV_DISPATCH_RECAL_S")
+        if period and period > 0:
+            self._recal_thread = threading.Thread(
+                target=self._recal_loop, args=(period,), daemon=True
+            )
+            self._recal_thread.start()
+
+    def apply_calibration(self, cal: dict) -> None:
+        """(Re-)point the dispatchers' host/device thresholds at a
+        calibration verdict — boot and every recalibration.  The tier
+        decision lives inside each launch, so no dispatcher restart
+        (and no caller disruption) is needed when the verdict moves.
+        Note the sidecar intentionally does NOT adopt ``prefer_host``
+        inline bypass: tenants must keep coalescing through the queue
+        even on a host-only box (occupancy stays observable)."""
+        from bftkv_tpu.ops import dispatch
+
+        if flags.raw("BFTKV_HOST_VERIFY_THRESHOLD") is None:
+            self.verify.verifier.host_threshold = cal["verify_crossover"]
+        if flags.raw("BFTKV_HOST_SIGN_THRESHOLD") is None:
+            if cal["sign_crossover"] is not None:
+                self.sign.signer.host_threshold = cal["sign_crossover"]
+            elif self.sign._signer_default_threshold is not None:
+                self.sign.signer.host_threshold = (
+                    self.sign._signer_default_threshold
+                )
+        self.modexp.device_threshold = (
+            dispatch.ALWAYS_HOST
+            if cal["prefer_host"]
+            else max(16, cal["verify_crossover"])
+        )
+        self._cal = cal
+
+    def recalibrate(self) -> dict:
+        """Force a fresh measurement and re-apply it (the
+        ``/recalibrate`` devtools hook and the periodic loop)."""
+        from bftkv_tpu.ops import dispatch
+
+        cal = dispatch.calibration(force=True)
+        self.apply_calibration(cal)
+        metrics.incr("sidecar.recalibrations")
+        return cal
+
+    def _recal_loop(self, period: float) -> None:
+        from bftkv_tpu.ops import dispatch
+
+        next_at = time.monotonic() + period
+        # Wake at min(period, 2 s): the periodic re-measure honors the
+        # full period, but the first-successful-launch trigger should
+        # not wait out a 60 s window to engage a device that just
+        # proved itself.
+        while not self._recal_stop.wait(timeout=min(period, 2.0)):
+            rtt = dispatch.observed_launch_rtt()
+            first_launch = rtt is not None and not self._recal_seen_rtt
+            if first_launch:
+                self._recal_seen_rtt = True
+            if first_launch or time.monotonic() >= next_at:
+                try:
+                    self.recalibrate()
+                except Exception:
+                    metrics.incr("sidecar.recalibration_errors")
+                next_at = time.monotonic() + period
 
     def stop(self) -> None:
+        self._recal_stop.set()
+        if self._recal_thread is not None:
+            self._recal_thread.join(timeout=5)
+            self._recal_thread = None
         self.verify.stop()
         self.sign.stop()
         self.modexp.stop()
@@ -426,6 +495,9 @@ class SidecarService:
                 ),
             }
 
+        from bftkv_tpu.ops import devbuf, dispatch
+
+        rtt = dispatch.observed_launch_rtt()
         return {
             "uptime_s": round(time.monotonic() - self._t0, 1),
             "queue": {
@@ -442,6 +514,20 @@ class SidecarService:
                 "verify": disp("dispatch"),
                 "sign": disp("signdispatch"),
                 "modexp": disp("modexpdispatch"),
+            },
+            "device_plane": {
+                "calibration": {
+                    k: self._cal.get(k)
+                    for k in (
+                        "backend",
+                        "verify_crossover",
+                        "prefer_host",
+                        "source",
+                    )
+                },
+                "launch_rtt_s": None if rtt is None else round(rtt, 6),
+                "recalibrations": snap.get("sidecar.recalibrations", 0),
+                "buffer_rings": devbuf.stats(),
             },
         }
 
@@ -694,10 +780,28 @@ class _StatsHandler(BaseHTTPRequestHandler):
                     200,
                     json.dumps(doc, sort_keys=True, default=str).encode(),
                 )
+            elif path == "/recalibrate":
+                # Devtools hook (ISSUE 19 satellite): force a fresh
+                # host/device calibration and re-apply it live.  GET for
+                # curl convenience; the stats port is loopback/operator
+                # surface, and the action is idempotent re-measurement.
+                cal = self.server.service.recalibrate()
+                self._reply(
+                    200, json.dumps(cal, sort_keys=True, default=str).encode()
+                )
             else:
                 self._reply(404, b'"unknown endpoint"')
         except Exception as e:  # operator surface: never kill the sidecar
             self._reply(500, json.dumps(str(e)).encode())
+
+    def do_POST(self):
+        # Drain any body so keep-alive framing survives the reply.
+        ln = int(self.headers.get("content-length") or 0)
+        if ln:
+            self.rfile.read(min(ln, 1 << 16))
+        if self.path == "/recalibrate":
+            return self.do_GET()
+        self._reply(404, b'"unknown endpoint"')
 
 
 def serve(

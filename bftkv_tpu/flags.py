@@ -243,6 +243,28 @@ _flag("BFTKV_DISPATCH_CALIBRATE", "1", "switch",
 _flag("BFTKV_DISPATCH_PIPELINE", None, "int",
       "Flushes in flight at once in the batching dispatcher (unset: "
       "backend-dependent default).")
+_flag("BFTKV_DISPATCH_ASYNC", "on", "switch",
+      "Async mega-batch dispatch: flush workers hand non-blocking "
+      "device launches to a completion-drain thread, so flush N+1's "
+      "host assembly overlaps flush N's device execution; `off` "
+      "restores fully synchronous flushes (the pre-r11 behavior).")
+_flag("BFTKV_DISPATCH_CROSSOVER", None, "int",
+      "Operator override for the host/device verify crossover batch "
+      "size (0 or negative pins always-host; unset: measured by "
+      "dispatch calibration and re-measured online from launch RTTs).")
+_flag("BFTKV_DISPATCH_RECAL_S", "60", "float",
+      "Sidecar online-recalibration period in seconds: the boot-time "
+      "crossover pin is re-measured from observed launch RTTs, so an "
+      "attached accelerator engages without a restart (0 disables).")
+_flag("BFTKV_DISPATCH_DEVBUF", "on", "switch",
+      "Persistent per-limb-width staging buffer rings for device "
+      "launches: flushes write batches into pre-allocated slot arrays "
+      "(pad rows broadcast, never re-converted); `off` re-allocates "
+      "per launch.")
+_flag("BFTKV_DISPATCH_DEVBUF_RING", "4", "int",
+      "Slots per width-class buffer ring; with every slot in flight "
+      "the next flush allocates fresh arrays (devbuf.overflow) "
+      "instead of blocking behind the device.")
 _flag("BFTKV_TPU_MIN_MODEXP_BATCH", "4", "int",
       "Smallest batch worth a device modexp launch.")
 _flag("BFTKV_RNS_POW_BACKEND", "auto", "str",

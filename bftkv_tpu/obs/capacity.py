@@ -193,7 +193,20 @@ def compute_member(
             "flushes": flushes,
         }
     if disps:
-        rows["dispatch"] = {
+        # Device-plane signals (r11): observed launch RTT (the online
+        # recalibration EWMA) and per-width staging buffer-ring
+        # saturation.  A full ring means flushes are allocating fresh
+        # staging arrays behind a busy device — the buffer rings are the
+        # wall, which folds into the row's saturation alongside caller
+        # wait: either one pushing up is the dispatch plane telling the
+        # fleet it cannot absorb more offered load.
+        launch_rtt = _first(idx, "dispatch.launch_rtt")
+        rings = {
+            labels.get("width", "all"): v
+            for labels, v in idx.get("devbuf.saturation", ())
+        }
+        ring_sat = max(rings.values(), default=0.0)
+        row = {
             "utilization": max(
                 (
                     occ
@@ -202,14 +215,24 @@ def compute_member(
                 ),
                 default=0.0,
             ),
-            "saturation": min(
-                1.0,
-                max(d["wait_p99_s"] for d in disps.values()) / wait_ref,
+            "saturation": max(
+                min(
+                    1.0,
+                    max(d["wait_p99_s"] for d in disps.values()) / wait_ref,
+                ),
+                min(1.0, ring_sat),
             ),
             "errors": 0.0,
             "_traffic": any(d["flushes"] > 0 for d in disps.values()),
             "dispatchers": disps,
         }
+        if launch_rtt is not None:
+            row["launch_rtt_s"] = round(launch_rtt, 6)
+        if rings:
+            row["buffer_rings"] = {
+                w: round(v, 4) for w, v in sorted(rings.items())
+            }
+        rows["dispatch"] = row
 
     # -- fanout_pool -------------------------------------------------------
     cap = _first(idx, "transport.pool.cap", resource="fanout_pool")

@@ -1,0 +1,190 @@
+"""Persistent width-keyed staging buffer rings for device launches.
+
+Every batched kernel entry point (``rns.power_mod_rns``, the EC
+scalar-mult path) used to rebuild its padded operand arrays from
+scratch on EVERY flush: convert ``t`` live rows plus up to
+``padded - t`` PAD rows through the int→limb→half pipelines, then hand
+freshly-allocated numpy arrays to the jit.  At mega-batch rates that
+host-side marshalling is pure overhead — the pad region never carries
+information (rows past ``t`` are discarded), yet it was re-converted
+through the same big-int pipeline as live data, and the allocator
+churned multi-MB arrays per launch.
+
+This module owns the fix: one :class:`BufferRing` per (width class,
+padded shape) holds a small ring of pre-allocated slot arrays that
+flushes write into *in place*.  Live rows land in ``[:t]``; the pad
+region is a broadcast copy of row 0 (bit-identical to the historical
+pad-with-item-0 convention, so kernels see byte-for-byte the same
+operands — the host/device parity oracle stays intact).  A slot is
+exclusively owned from :meth:`BufferRing.acquire` until
+:meth:`BufferRing.release` — the in-flight bit flips under the ring
+lock and release asserts it, so a buffer can never be reused while a
+flush (or its async completion) is still in flight.  When every slot
+is in flight the ring does NOT block the collector behind the device:
+``acquire`` returns ``None`` (counted as ``devbuf.overflow``) and the
+caller falls back to a fresh allocation for that launch.
+
+Ring saturation is a first-class capacity signal: the
+``devbuf.saturation`` gauge (per ``width`` label) feeds the capacity
+plane's dispatch resource row (DESIGN.md §22), so a fleet operator
+sees "the buffer rings are the wall" next to device occupancy and
+launch RTT.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from bftkv_tpu import flags
+from bftkv_tpu.metrics import registry as metrics
+from bftkv_tpu.devtools.lockwatch import named_lock
+
+__all__ = ["BufferRing", "Slot", "enabled", "ring_for", "reset", "stats"]
+
+_lock = named_lock("ops.devbuf")
+_RINGS: dict[str, "BufferRing"] = {}
+
+
+def enabled() -> bool:
+    return flags.enabled("BFTKV_DISPATCH_DEVBUF")
+
+
+class Slot:
+    """One pre-allocated staging buffer set (a dict of numpy arrays).
+
+    Ownership protocol: exclusively the acquirer's from ``acquire()``
+    until ``release()``.  ``seq`` increments per acquisition so a
+    stale release (double-release after an async completion raced a
+    crash path) is detectable instead of silently corrupting the next
+    flush's operands.
+    """
+
+    __slots__ = ("arrays", "in_flight", "seq")
+
+    def __init__(self, arrays: dict):
+        self.arrays = arrays
+        self.in_flight = False
+        self.seq = 0
+
+    def __getitem__(self, name: str):
+        return self.arrays[name]
+
+
+class BufferRing:
+    """A fixed ring of staging slots for one width class.
+
+    ``make`` builds one slot's array dict; it runs at ring creation
+    (all slots pre-allocated up front — a launch never pays allocator
+    latency) and whenever an overflow fallback needs a throwaway slot.
+    ``width`` is the bounded metrics label value (a limb count such as
+    ``"128"``, or ``"ec"``).
+    """
+
+    def __init__(self, key: str, make, *, slots: int | None = None,
+                 width: str = "all"):
+        if slots is None:
+            slots = flags.get_int("BFTKV_DISPATCH_DEVBUF_RING") or 4
+        self.key = key
+        self.width = width
+        self._make = make
+        self._cv = threading.Condition(_lock)
+        self._slots = [Slot(make()) for _ in range(max(1, slots))]
+        self.overflows = 0
+        self.acquires = 0
+
+    def _gauge(self) -> None:
+        busy = sum(1 for s in self._slots if s.in_flight)
+        metrics.gauge(
+            "devbuf.in_flight", busy, labels={"width": self.width}
+        )
+        metrics.gauge(
+            "devbuf.saturation",
+            busy / len(self._slots),
+            labels={"width": self.width},
+        )
+
+    def acquire(self, timeout: float = 0.0) -> Slot | None:
+        """A free slot, or ``None`` when the whole ring is in flight
+        (after waiting up to ``timeout``).  ``None`` tells the caller
+        to allocate fresh for this launch — the ring bounds memory, it
+        must never bound liveness (a wedged device completion would
+        otherwise deadlock every later flush)."""
+        with self._cv:
+            deadline = None
+            while True:
+                for s in self._slots:
+                    if not s.in_flight:
+                        s.in_flight = True
+                        s.seq += 1
+                        self.acquires += 1
+                        self._gauge()
+                        return s
+                if timeout <= 0:
+                    break
+                if deadline is None:
+                    deadline = time.monotonic() + timeout
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or not self._cv.wait(timeout=remaining):
+                    break
+            self.overflows += 1
+            metrics.incr("devbuf.overflow", labels={"width": self.width})
+            self._gauge()
+            return None
+
+    def fresh(self) -> Slot:
+        """An unpooled slot for the overflow path: same shapes, same
+        write-in-place fill code, but owned by this launch alone and
+        garbage-collected after it."""
+        s = Slot(self._make())
+        s.in_flight = True
+        s.seq = 1
+        return s
+
+    def release(self, slot: Slot) -> None:
+        if slot not in self._slots:
+            return  # overflow (fresh) slot: nothing to return to the ring
+        with self._cv:
+            assert slot.in_flight, "devbuf: release of a slot not in flight"
+            slot.in_flight = False
+            self._gauge()
+            self._cv.notify()
+
+
+def ring_for(key: str, make, *, slots: int | None = None,
+             width: str = "all") -> BufferRing:
+    """The process-wide ring for ``key`` (created on first use).
+
+    ``key`` encodes the full padded shape family (e.g.
+    ``pow:38:608:256:64``) so a shape change mints a new ring instead
+    of corrupting an old one; ``width`` is the bounded label the
+    ring's gauges carry.
+    """
+    with _lock:
+        r = _RINGS.get(key)
+        if r is None:
+            r = _RINGS[key] = BufferRing(
+                key, make, slots=slots, width=width
+            )
+        return r
+
+
+def stats() -> dict:
+    """Per-ring occupancy snapshot (sidecar /info + tests)."""
+    with _lock:
+        return {
+            key: {
+                "width": r.width,
+                "slots": len(r._slots),
+                "in_flight": sum(1 for s in r._slots if s.in_flight),
+                "acquires": r.acquires,
+                "overflows": r.overflows,
+            }
+            for key, r in _RINGS.items()
+        }
+
+
+def reset() -> None:
+    """Drop every ring (tests; a leaked in-flight slot dies with it)."""
+    with _lock:
+        _RINGS.clear()

@@ -58,6 +58,9 @@ __all__ = [
     "uninstall_signer",
     "get_signer",
     "uninstall_all",
+    "note_launch_rtt",
+    "observed_launch_rtt",
+    "recalibrate",
 ]
 
 
@@ -66,6 +69,30 @@ ALWAYS_HOST = 1 << 30
 
 _CALIBRATION: dict | None = None
 _calibration_lock = named_lock("dispatch.calibration")
+_LAUNCH_RTT_EWMA: float | None = None
+
+
+def note_launch_rtt(seconds: float) -> None:
+    """Feed one observed launch round trip into the online-recalibration
+    EWMA (α = 0.2) and the ``dispatch.launch_rtt`` gauge.
+
+    The boot-time calibration probes a trivial jitted op; real flushes
+    measure the thing itself.  :func:`recalibrate` prefers this series
+    over a fresh probe, so a tunneled accelerator whose RTT drifts (or
+    a device that appears mid-run) re-prices the crossover from what
+    launches actually cost."""
+    global _LAUNCH_RTT_EWMA
+    with _calibration_lock:
+        prev = _LAUNCH_RTT_EWMA
+        _LAUNCH_RTT_EWMA = (
+            seconds if prev is None else 0.8 * prev + 0.2 * seconds
+        )
+        metrics.gauge("dispatch.launch_rtt", _LAUNCH_RTT_EWMA)
+
+
+def observed_launch_rtt() -> float | None:
+    with _calibration_lock:
+        return _LAUNCH_RTT_EWMA
 
 
 def calibration(force: bool = False) -> dict:
@@ -96,6 +123,28 @@ def calibration(force: bool = False) -> dict:
         import jax
 
         backend = jax.default_backend()
+        env = flags.raw("BFTKV_DISPATCH_CROSSOVER")
+        if env is not None:
+            # Operator override: outranks every measurement.  ≤ 0 pins
+            # always-host; a positive value is the verify crossover
+            # batch size (and un-pins the backend regardless of what a
+            # probe would say — the operator knows their accelerator).
+            x = int(env)
+            pinned = x <= 0
+            cal = {
+                "backend": backend,
+                "host_verify_s": None,
+                "device_rtt_s": _LAUNCH_RTT_EWMA,
+                "verify_crossover": ALWAYS_HOST if pinned else x,
+                "sign_crossover": ALWAYS_HOST if pinned else None,
+                "prefer_host": pinned,
+                "source": "override",
+            }
+            metrics.gauge(
+                "dispatch.crossover", -1 if pinned else x
+            )
+            _CALIBRATION = cal
+            return cal
         # Host per-item cost: raw pow on a fixed odd 2048-bit modulus —
         # the dominant term of a host verify, no keygen required.
         n = (1 << 2047) + 973  # odd, full-width; exactness is irrelevant
@@ -113,17 +162,26 @@ def calibration(force: bool = False) -> dict:
                 "verify_crossover": ALWAYS_HOST,
                 "sign_crossover": ALWAYS_HOST,
                 "prefer_host": True,
+                "source": "probe",
             }
         else:
-            import jax.numpy as jnp
+            # Online recalibration: once real flushes have measured
+            # their own round trips (note_launch_rtt), the EWMA of the
+            # thing itself outranks the trivial-op probe — the probe is
+            # a lower bound, the EWMA is the price actually paid.
+            rtt = _LAUNCH_RTT_EWMA
+            source = "observed"
+            if rtt is None:
+                import jax.numpy as jnp
 
-            f = jax.jit(lambda x: x * 2 + 1)
-            x = jax.device_put(jnp.zeros((256, 128), jnp.uint32))
-            jax.block_until_ready(f(x))  # compile outside the timing
-            t0 = time.perf_counter()
-            for _ in range(3):
-                jax.block_until_ready(f(x))
-            rtt = (time.perf_counter() - t0) / 3
+                f = jax.jit(lambda x: x * 2 + 1)
+                x = jax.device_put(jnp.zeros((256, 128), jnp.uint32))
+                jax.block_until_ready(f(x))  # compile outside the timing
+                t0 = time.perf_counter()
+                for _ in range(3):
+                    jax.block_until_ready(f(x))
+                rtt = (time.perf_counter() - t0) / 3
+                source = "probe"
             cal = {
                 "backend": backend,
                 "host_verify_s": host_s,
@@ -135,6 +193,7 @@ def calibration(force: bool = False) -> dict:
                 # keep the signer's proven default on real devices.
                 "sign_crossover": None,
                 "prefer_host": False,
+                "source": source,
             }
         metrics.gauge(
             "dispatch.crossover",
@@ -201,6 +260,17 @@ class _BatchDispatcher:
         self._inflight: threading.BoundedSemaphore | None = None
         self._work: "queue.SimpleQueue[list[_Pending] | None]" | None = None
         self._workers: list[threading.Thread] = []
+        #: Async mega-batch dispatch (``BFTKV_DISPATCH_ASYNC``): flushes
+        #: whose subclass implements :meth:`_launch_batch` hand the
+        #: device a non-blocking launch and return immediately; a single
+        #: completion-drain thread finalizes launches FIFO and scatters
+        #: results, so flush N+1's host assembly overlaps flush N's
+        #: device execution.  ``off`` restores the fully synchronous
+        #: flush (pre-r11 behavior, byte for byte).
+        self._async = flags.enabled("BFTKV_DISPATCH_ASYNC")
+        self._completions: "queue.SimpleQueue | None" = None
+        self._async_slots: threading.BoundedSemaphore | None = None
+        self._drain: threading.Thread | None = None
         self._lock = named_lock("dispatch.batcher")
         self._cv = threading.Condition(self._lock)
         self._queue: list[_Pending] = []
@@ -213,6 +283,17 @@ class _BatchDispatcher:
     def _run_batch(self, items: list):
         """One batched launch; returns a sequence aligned with items."""
         raise NotImplementedError
+
+    def _launch_batch(self, items: list):
+        """Non-blocking launch hook for the async path: stage ``items``
+        into (persistent) device buffers, hand the kernel launch to the
+        device WITHOUT blocking on its result, and return a zero-arg
+        completion callable that blocks on the device and returns a
+        sequence aligned with ``items``.  Return ``None`` to decline —
+        the flush then takes the synchronous :meth:`_run_batch` path
+        (the default: only subclasses with a genuinely async device
+        tier opt in)."""
+        return None
 
     def prefer_host(self, n_items: int) -> bool:
         """True when calibration proved these items end on host either
@@ -260,6 +341,23 @@ class _BatchDispatcher:
             ]
             for w in self._workers:
                 w.start()
+        if self._async and self._drain is None:
+            # One drain thread regardless of pipeline width: completions
+            # finalize FIFO, so async callers observe the same wake
+            # ordering the synchronous path gave them.  The semaphore
+            # bounds launches dispatched but not yet finalized —
+            # assembly of the next flush overlaps the device, but a slow
+            # device cannot accumulate unbounded staged batches.
+            self._completions = queue.SimpleQueue()
+            self._async_slots = threading.BoundedSemaphore(
+                (self.pipeline or 1) + 1
+            )
+            self._drain = threading.Thread(
+                target=self._completion_drain,
+                args=(self._completions,),
+                daemon=True,
+            )
+            self._drain.start()
         self._thread = threading.Thread(target=self._collector, daemon=True)
         self._thread.start()
         return self
@@ -284,6 +382,16 @@ class _BatchDispatcher:
             self._workers = []
             self._work = None
             self._inflight = None
+        # Drain the completion thread LAST: the collector and every
+        # flush worker are joined above, so all async launches are
+        # already enqueued ahead of this sentinel (FIFO) — no caller's
+        # completion can arrive after it.
+        if self._drain is not None:
+            self._completions.put(None)
+            self._drain.join(timeout=5)
+            self._drain = None
+            self._completions = None
+            self._async_slots = None
 
     def _flush_worker(self, work, inflight) -> None:
         # Queue + semaphore ride in as locals: a worker abandoned by a
@@ -424,6 +532,34 @@ class _BatchDispatcher:
             labels={"width": "all"},
         )
         t0 = time.perf_counter()
+        if (
+            self._async
+            and self._completions is not None
+            and len(flat) <= self.max_batch
+        ):
+            # Async path: ask the subclass for a non-blocking launch.
+            # Semaphore + completion queue ride in as locals for the
+            # same abandoned-worker reason as _flush_worker.
+            slots, completions = self._async_slots, self._completions
+            slots.acquire()
+            completion = None
+            try:
+                with trace.span(
+                    f"{self.name}.launch",
+                    attrs={"batch_size": len(flat)},
+                    phase="dispatch",
+                ):
+                    completion = self._launch_batch(flat)
+            except Exception as e:
+                slots.release()
+                for p in batch:
+                    p.error = e
+                    p.event.set()
+                return
+            if completion is not None:
+                completions.put((batch, len(flat), completion, t0, slots))
+                return
+            slots.release()
         # Each flush is its own (root) trace: device batches are shared
         # across requests, so they cannot belong to any one request's
         # trace — the span carries the batch shape and, once the launch
@@ -473,6 +609,37 @@ class _BatchDispatcher:
             off += len(p.items)
             p.event.set()
 
+    def _completion_drain(self, completions) -> None:
+        # Finalizes async launches strictly FIFO: block on the device
+        # result, scatter to futures, feed the observed round trip into
+        # online recalibration.  A completion that raises reaches its
+        # callers through their futures — the drain thread, like the
+        # flush workers, must never die to an item error.
+        while True:
+            entry = completions.get()
+            if entry is None:
+                return
+            batch, n_items, completion, t0, slots = entry
+            try:
+                out = completion()
+            except Exception as e:
+                for p in batch:
+                    p.error = e
+                    p.event.set()
+                continue
+            finally:
+                slots.release()
+            dt = time.perf_counter() - t0
+            metrics.observe(f"{self.name}.flush.seconds", dt)
+            if dt > 0:
+                metrics.gauge(f"{self.name}.throughput", n_items / dt)
+            note_launch_rtt(dt)
+            off = 0
+            for p in batch:
+                p.result = out[off : off + len(p.items)]
+                off += len(p.items)
+                p.event.set()
+
 
 class VerifyDispatcher(_BatchDispatcher):
     """Batched signature verification (items: (message, sig, PublicKey))."""
@@ -503,13 +670,17 @@ class VerifyDispatcher(_BatchDispatcher):
     def start(self):
         super().start()
         if self._calibrate:
-            cal = calibration()
-            # An explicit env threshold is the operator's word and
-            # outranks the measurement.
-            if flags.raw("BFTKV_HOST_VERIFY_THRESHOLD") is None:
-                self.verifier.host_threshold = cal["verify_crossover"]
-            self._prefer_host = cal["prefer_host"]
+            self.apply_calibration(calibration())
         return self
+
+    def apply_calibration(self, cal: dict) -> None:
+        """(Re-)apply a calibration verdict — called at start() and by
+        :func:`recalibrate` when online measurement moves the pin."""
+        # An explicit env threshold is the operator's word and
+        # outranks the measurement.
+        if flags.raw("BFTKV_HOST_VERIFY_THRESHOLD") is None:
+            self.verifier.host_threshold = cal["verify_crossover"]
+        self._prefer_host = cal["prefer_host"]
 
     def _run_batch(self, items: list):
         return self.verifier.verify_batch(items)
@@ -565,21 +736,34 @@ class SignDispatcher(_BatchDispatcher):
 
             signer = rsamod.SignerDomain()
         self.signer = signer
+        # The signer's proven built-in crossover, captured before any
+        # calibration pin touches it: a later recalibration that
+        # un-pins the backend (accelerator appeared) restores this
+        # rather than leaving the boot-time ALWAYS_HOST in place.
+        self._signer_default_threshold = getattr(
+            signer, "host_threshold", None
+        )
 
     def start(self):
         super().start()
         if self._calibrate:
-            cal = calibration()
-            self._prefer_host = cal["prefer_host"]
-            if (
-                cal["sign_crossover"] is not None
-                and flags.raw("BFTKV_HOST_SIGN_THRESHOLD") is None
-            ):
-                # CPU backend: any flush that still lands here (e.g. a
-                # caller ignoring prefer_host) must host-sign rather
-                # than sink seconds into a CPU-XLA modexp launch.
-                self.signer.host_threshold = cal["sign_crossover"]
+            self.apply_calibration(calibration())
         return self
+
+    def apply_calibration(self, cal: dict) -> None:
+        self._prefer_host = cal["prefer_host"]
+        if flags.raw("BFTKV_HOST_SIGN_THRESHOLD") is not None:
+            return
+        if cal["sign_crossover"] is not None:
+            # CPU backend: any flush that still lands here (e.g. a
+            # caller ignoring prefer_host) must host-sign rather
+            # than sink seconds into a CPU-XLA modexp launch.
+            self.signer.host_threshold = cal["sign_crossover"]
+        elif self._signer_default_threshold is not None:
+            # Backend (re-)engaged: the pin above may still be in place
+            # from an earlier all-host verdict — restore the signer's
+            # proven default crossover.
+            self.signer.host_threshold = self._signer_default_threshold
 
     def _run_batch(self, items: list):
         from bftkv_tpu.crypto import cert as certmod
@@ -667,6 +851,33 @@ class ModexpDispatcher(_BatchDispatcher):
             else ALWAYS_HOST
         )
 
+    def apply_calibration(self, cal: dict) -> None:
+        self._prefer_host = cal["prefer_host"]
+        self.device_threshold = (
+            ALWAYS_HOST if cal["prefer_host"] else cal["verify_crossover"]
+        )
+
+    def _width_groups(self, items: list, device_idx: list[int]):
+        from bftkv_tpu.ops import limb as limb_ops
+
+        # One launch per limb-width group (uniform kernel shapes).
+        by_width: dict[int, list[int]] = {}
+        for i in device_idx:
+            w = limb_ops.nlimbs_for_bits(items[i][2].bit_length())
+            by_width.setdefault(w, []).append(i)
+        return by_width
+
+    def _note_device_group(self, w: int, idxs: list[int]) -> None:
+        metrics.incr("modexp.device", len(idxs))
+        # Per-limb-width device occupancy: widths are the handful of
+        # deployed modulus sizes, so the label stays bounded (capacity
+        # plane joins on `width`).
+        metrics.gauge(
+            "modexpdispatch.device_occupancy",
+            min(1.0, len(idxs) / self.max_batch),
+            labels={"width": str(w)},
+        )
+
     def _run_batch(self, items: list) -> list[int]:
         out: list[int | None] = [None] * len(items)
         device_idx: list[int] = []
@@ -677,15 +888,9 @@ class ModexpDispatcher(_BatchDispatcher):
                 if m > 2 and m % 2 == 1 and e >= 0 and 0 <= b
             ]
         if device_idx:
-            from bftkv_tpu.ops import limb as limb_ops
             from bftkv_tpu.ops import rns as rns_ops
 
-            # One launch per limb-width group (uniform kernel shapes).
-            by_width: dict[int, list[int]] = {}
-            for i in device_idx:
-                w = limb_ops.nlimbs_for_bits(items[i][2].bit_length())
-                by_width.setdefault(w, []).append(i)
-            for w, idxs in by_width.items():
+            for w, idxs in self._width_groups(items, device_idx).items():
                 try:
                     vals = rns_ops.power_mod_rns(
                         [items[i][0] for i in idxs],
@@ -696,17 +901,65 @@ class ModexpDispatcher(_BatchDispatcher):
                 except Exception:
                     vals = None  # incapable/hostile moduli: host below
                 if vals is not None:
-                    metrics.incr("modexp.device", len(idxs))
-                    # Per-limb-width device occupancy: widths are the
-                    # handful of deployed modulus sizes, so the label
-                    # stays bounded (capacity plane joins on `width`).
-                    metrics.gauge(
-                        "modexpdispatch.device_occupancy",
-                        min(1.0, len(idxs) / self.max_batch),
-                        labels={"width": str(w)},
-                    )
+                    self._note_device_group(w, idxs)
                     for i, v in zip(idxs, vals):
                         out[i] = int(v)
+        self._host_fill(items, out)
+        return out  # type: ignore[return-value]
+
+    def _launch_batch(self, items: list):
+        """Async tier: dispatch EVERY width group's launch before
+        blocking on ANY — RSA-2048 and RSA-3072 super-flushes ride the
+        device stream back to back instead of round-tripping one group
+        at a time.  Declines (``None`` → sync path) below the device
+        threshold or when the batch mixes in device-ineligible items,
+        so the host tier's behavior is untouched on calibrated-host
+        backends."""
+        if len(items) < self.device_threshold:
+            return None
+        if not all(
+            m > 2 and m % 2 == 1 and e >= 0 and 0 <= b
+            for b, e, m in items
+        ):
+            return None
+        from bftkv_tpu.ops import rns as rns_ops
+
+        launches: list[tuple[int, list[int], object]] = []
+        for w, idxs in self._width_groups(
+            items, list(range(len(items)))
+        ).items():
+            try:
+                d = rns_ops.power_mod_rns(
+                    [items[i][0] for i in idxs],
+                    [items[i][1] for i in idxs],
+                    [items[i][2] for i in idxs],
+                    n_bits=w * 16,
+                    defer=True,
+                )
+            except Exception:
+                d = None  # incapable moduli: host fallback on complete
+            launches.append((w, idxs, d))
+
+        def complete() -> list[int]:
+            out: list[int | None] = [None] * len(items)
+            for w, idxs, d in launches:
+                vals = None
+                if d is not None:
+                    try:
+                        vals = d.wait()
+                    except Exception:
+                        vals = None  # device failure: host fallback
+                if vals is not None:
+                    self._note_device_group(w, idxs)
+                    for i, v in zip(idxs, vals):
+                        out[i] = int(v)
+            self._host_fill(items, out)
+            return out  # type: ignore[return-value]
+
+        return complete
+
+    def _host_fill(self, items: list, out: list) -> None:
+        """Host tier for every item the device didn't answer."""
         from bftkv_tpu.crypto import rsa as rsamod
 
         host = 0
@@ -730,7 +983,6 @@ class ModexpDispatcher(_BatchDispatcher):
                 out[i] = pow(b, e, m)
         if host:
             metrics.incr("modexp.host", host)
-        return out  # type: ignore[return-value]
 
     def _combine(self, chunks: list):
         return [v for chunk in chunks for v in chunk]
@@ -792,6 +1044,24 @@ def uninstall_signer() -> None:
 
 def get_signer() -> SignDispatcher | None:
     return _global_signer
+
+
+def recalibrate() -> dict:
+    """Force a fresh calibration and re-apply it to the installed
+    dispatchers.
+
+    This is the piece the boot-time pin was missing: ``calibration``
+    always supported ``force=True`` but nothing ever called it after
+    process start, so an accelerator attached (or un-wedged) mid-run
+    could never flip the ``ALWAYS_HOST`` verdict.  Exposed to operators
+    through the sidecar's ``/recalibrate`` devtools hook and run
+    periodically by the sidecar (``BFTKV_DISPATCH_RECAL_S``)."""
+    cal = calibration(force=True)
+    with _global_lock:
+        for d in (_global, _global_signer):
+            if d is not None and d._calibrate:
+                d.apply_calibration(cal)
+    return cal
 
 
 def uninstall_all() -> None:

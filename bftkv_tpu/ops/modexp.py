@@ -77,9 +77,13 @@ class BatchModExp:
         # Sub-2^12 primes cannot fund a 4096-bit base pair, so wider
         # operands (threshold-RSA fragment exponents grow past the key
         # size per tree level, rsa.go:97-117) stay on the limb path.
+        # power_mod_rns stages operands through the persistent devbuf
+        # ring for its width class, so per-call marshalling here is
+        # just the list splits below.
         width = max(n.bit_length(), max_e.bit_length())
         nb = next((w for w in (1024, 2048) if width <= w), None)
         if nb is not None:
+            from bftkv_tpu.metrics import registry as metrics
             from bftkv_tpu.ops import rns
 
             try:
@@ -93,14 +97,13 @@ class BatchModExp:
                 # power_mod_rns signals every *legitimately* incapable
                 # input by returning None; an exception is an
                 # unexpected defect — degrade, but loudly.
-                from bftkv_tpu.metrics import registry as metrics
-
                 metrics.incr("modexp.rns_error")
                 logging.getLogger(__name__).exception(
                     "RNS modexp failed; falling back to limb kernel"
                 )
                 vals = None
             if vals is not None:
+                metrics.incr("modexp.rns_staged", len(pairs))
                 return vals
             # else: RNS-incapable modulus (None) or logged error —
             # fall through to the limb path either way.

@@ -37,6 +37,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from bftkv_tpu.ops import devbuf
 from bftkv_tpu.ops import limb
 from bftkv_tpu import flags
 
@@ -486,12 +487,21 @@ def _pow_kernel(cn: _Consts, base_halves, exp_nibbles_t, key):
 
 
 @functools.lru_cache(maxsize=4)
-def _jitted_pow(digits: int, n_bits: int):
+def _jitted_pow(digits: int, n_bits: int, donate: bool = False):
     """uint8 operands + device-side gather of the (few) unique moduli —
-    same transfer-lean scheme as the verify path."""
+    same transfer-lean scheme as the verify path.
+
+    ``donate=True`` (accelerator backends only) donates the per-batch
+    operand buffers: XLA may alias the freshly-transferred arrays into
+    the kernel instead of defensively copying them — the host-side
+    staging slot (:mod:`bftkv_tpu.ops.devbuf`) stays owned by the host
+    and is reused for the next flush.  CPU ignores donation with a
+    warning, so callers gate it on the backend."""
     cn = _Consts(context(digits, n_bits))
 
-    @jax.jit
+    @functools.partial(
+        jax.jit, donate_argnums=(0, 1, 2) if donate else ()
+    )
     def g(base_halves_u8, exp_nibbles_t_u8, idx, ukey):
         key = tuple(u[idx] for u in ukey)
         return _pow_kernel(
@@ -534,8 +544,55 @@ def _sigma_to_ints(ctx: RNSContext, sigma: np.ndarray) -> list[int]:
     return [v % ctx.M for v in vals]
 
 
+class DeferredModexp:
+    """Handle for a non-blocking :func:`power_mod_rns` launch.
+
+    The kernel is already on the device stream when this is returned;
+    :meth:`wait` materializes the device result, rebuilds the integers,
+    and releases the staging slot.  Exactly one waiter finalizes it
+    (the dispatcher's completion-drain thread)."""
+
+    __slots__ = ("_finish", "_value", "_done")
+
+    def __init__(self, finish):
+        self._finish = finish
+        self._value = None
+        self._done = False
+
+    def wait(self) -> list[int]:
+        if not self._done:
+            self._done = True
+            fin, self._finish = self._finish, None
+            self._value = fin()
+        return self._value
+
+
+def _pow_staging(digits: int, n_bits: int, padded: int):
+    """One launch's operand arrays — a persistent devbuf slot when the
+    rings are on (``None`` ring → plain throwaway arrays)."""
+    shapes = {
+        "base_halves": ((padded, 2 * digits), np.uint8),
+        "nib_t": ((4 * digits, padded), np.uint8),
+        "idx": ((padded,), np.int32),
+    }
+
+    def make():
+        return {k: np.empty(s, d) for k, (s, d) in shapes.items()}
+
+    if not devbuf.enabled():
+        return None, devbuf.Slot(make())
+    ring = devbuf.ring_for(
+        f"pow:{digits}:{n_bits}:{padded}", make, width=str(digits)
+    )
+    slot = ring.acquire()
+    if slot is None:
+        return None, ring.fresh()  # ring saturated: unpooled fallback
+    return ring, slot
+
+
 def power_mod_rns(
-    bases: list[int], exps: list[int], mods: list[int], *, n_bits: int = 1024
+    bases: list[int], exps: list[int], mods: list[int], *,
+    n_bits: int = 1024, defer: bool = False,
 ):
     """Batched x^e mod m with per-row (x, e, m) — the threshold-RSA /
     CRT-signing workhorse.  Returns a list of ints, or None when any
@@ -544,6 +601,11 @@ def power_mod_rns(
     ``n_bits`` bounds the modulus/exponent width; 1024 covers the CRT
     halves of RSA-2048 (reference hot loop: crypto_pgp.go:346-371,
     threshold fragments rsa.go:140-178).
+
+    ``defer=True`` returns a :class:`DeferredModexp` instead of a list:
+    the launch is dispatched but NOT blocked on, so the caller (the
+    async dispatcher) can stage further width groups while the device
+    works.  The staging slot stays in flight until ``wait()``.
     """
     if not mods:
         return []
@@ -571,58 +633,104 @@ def power_mod_rns(
     # and every fresh (T, K) pair would recompile the 256-step scan
     # (~15-60 s); 64 padded key rows are < 1 MB of extra transfer.
     padded = max(64, 1 << (t - 1).bit_length())
-    idxs += [0] * (padded - t)
-    bases = list(bases) + [bases[0]] * (padded - t)
-    exps = list(exps) + [exps[0]] * (padded - t)
-    mods = list(mods) + [mods[0]] * (padded - t)
     kpad = max(64, 1 << (len(urows) - 1).bit_length())
     urows += [urows[0]] * (kpad - len(urows))
     ukey = tuple(jnp.asarray(a) for a in stack_key_rows(urows))
-    base_digits = np.stack(
-        [limb.int_to_limbs(b % m, digits) for b, m in zip(bases, mods)]
-    )
-    ed = np.stack([limb.int_to_limbs(e, digits) for e in exps])  # (T, digits)
-    nibbles = np.empty((len(exps), digits * 4), dtype=np.uint8)
-    nibbles[:, 0::4] = ed & 0xF  # little-endian within each 16-bit digit
-    nibbles[:, 1::4] = (ed >> 4) & 0xF
-    nibbles[:, 2::4] = (ed >> 8) & 0xF
-    nibbles[:, 3::4] = (ed >> 12) & 0xF
-    nibbles = nibbles[:, ::-1]  # most-significant nibble first
-    pow_args = (
-        digits_to_halves_u8(base_digits),
-        np.ascontiguousarray(nibbles.T),
-        np.asarray(idxs, dtype=np.int32),
-        ukey,
-    )
-    sigma = None
-    if _use_pallas("BFTKV_RNS_POW_BACKEND"):
-        try:
-            from bftkv_tpu.ops import pallas_rns
+    # Stage operands into a persistent slot (devbuf ring) or throwaway
+    # arrays: ONLY the t live rows ride the int→limb→half pipeline; the
+    # pad region broadcasts row 0 in place, which is bit-identical to
+    # the historical pad-the-input-lists-with-item-0 convention (pad
+    # base = bases[0] % mods[0] = row 0's conversion; pad unique-index
+    # is 0 = row 0's by construction) without its per-pad-row bigint
+    # conversions or per-launch allocations.
+    ring, slot = _pow_staging(digits, n_bits, padded)
+    bh, nt, ix = slot["base_halves"], slot["nib_t"], slot["idx"]
+    released = False
 
-            sigma = np.asarray(
-                pallas_rns.pow_pallas(
-                    *pow_args, digits=digits, n_bits=n_bits
+    def _release():
+        nonlocal released
+        if not released:
+            released = True
+            if ring is not None:
+                ring.release(slot)
+
+    try:
+        base_digits = np.stack(
+            [limb.int_to_limbs(b % m, digits) for b, m in zip(bases, mods)]
+        )
+        bh[:t, 0::2] = base_digits & 0xFF
+        bh[:t, 1::2] = base_digits >> 8
+        ed = np.stack(
+            [limb.int_to_limbs(e, digits) for e in exps]
+        )  # (t, digits)
+        nib = np.empty((t, digits * 4), dtype=np.uint8)
+        nib[:, 0::4] = ed & 0xF  # little-endian within each 16-bit digit
+        nib[:, 1::4] = (ed >> 4) & 0xF
+        nib[:, 2::4] = (ed >> 8) & 0xF
+        nib[:, 3::4] = (ed >> 12) & 0xF
+        nt[:, :t] = nib[:, ::-1].T  # most-significant nibble first
+        ix[:t] = np.asarray(idxs, dtype=np.int32)
+        if padded > t:
+            bh[t:] = bh[0:1]
+            nt[:, t:] = nt[:, 0:1]
+            ix[t:] = 0
+        pow_args = (bh, nt, ix, ukey)
+        sigma = None
+        if _use_pallas("BFTKV_RNS_POW_BACKEND"):
+            try:
+                from bftkv_tpu.ops import pallas_rns
+
+                sigma = np.asarray(
+                    pallas_rns.pow_pallas(
+                        *pow_args, digits=digits, n_bits=n_bits
+                    )
+                )[:t]
+                _pallas_mark_proven("pow")
+            except Exception as e:
+                # A Mosaic compile/runtime failure must degrade to the
+                # XLA kernel, not sink the sign path — but loudly: a
+                # silent fallback would misattribute every benchmark
+                # number.
+                import logging
+
+                _PALLAS_STATUS["pow"] = f"fallback: {type(e).__name__}"
+                logging.getLogger("bftkv_tpu.ops.rns").exception(
+                    "pallas pow kernel failed; falling back to XLA"
                 )
-            )[:t]
-            _pallas_mark_proven("pow")
-        except Exception as e:
-            # A Mosaic compile/runtime failure must degrade to the XLA
-            # kernel, not sink the sign path — but loudly: a silent
-            # fallback would misattribute every benchmark number.
-            import logging
-
-            _PALLAS_STATUS["pow"] = f"fallback: {type(e).__name__}"
-            logging.getLogger("bftkv_tpu.ops.rns").exception(
-                "pallas pow kernel failed; falling back to XLA"
+        if sigma is not None:
+            _release()
+            vals = _sigma_to_ints(ctx, sigma)
+            res = [v % m for v, m in zip(vals, mods)]
+            return DeferredModexp(lambda: res) if defer else res
+        if _shardable(padded):
+            fn = _jitted_pow_sharded(digits, n_bits)
+        else:
+            # Donation only pays (and only works) on real accelerators;
+            # see _jitted_pow.
+            fn = _jitted_pow(
+                digits, n_bits,
+                donate=jax.default_backend() in ("tpu", "gpu"),
             )
-    if sigma is None and _shardable(padded):
-        sigma = np.asarray(
-            _jitted_pow_sharded(digits, n_bits)(*pow_args)
-        )[:t]
-    elif sigma is None:
-        sigma = np.asarray(_jitted_pow(digits, n_bits)(*pow_args))[:t]
-    vals = _sigma_to_ints(ctx, sigma)
-    return [v % m for v, m in zip(vals, mods[:t])]
+        dev = fn(*pow_args)  # jax dispatch is async: not a result yet
+        mods_live = list(mods)
+
+        def finish() -> list[int]:
+            try:
+                s = np.asarray(dev)[:t]
+            finally:
+                # Materialized (or launch failed): the device no longer
+                # reads the staging arrays either way.
+                _release()
+            vals = _sigma_to_ints(ctx, s)
+            return [v % m for v, m in zip(vals, mods_live)]
+
+        if defer:
+            # Slot ownership moves to the handle: finish() releases it.
+            return DeferredModexp(finish)
+        return finish()
+    except BaseException:
+        _release()
+        raise
 
 
 def digits_to_halves(digits_u32: np.ndarray) -> np.ndarray:

@@ -28,8 +28,8 @@ def detail_record(sections):
 def test_extracts_both_formats():
     d = extract_sections(driver_record({"cluster_4": ["cpu", 7.5],
                                         "rns_kernel": "skip"}))
-    assert d["cluster_4"] == ("cpu", 7.5, None, None, None)
-    assert d["rns_kernel"] == ("skip", None, None, None, None)
+    assert d["cluster_4"] == ("cpu", 7.5, None, None, None, None)
+    assert d["rns_kernel"] == ("skip", None, None, None, None, None)
     d = extract_sections(detail_record({
         "cluster_4": {"backend": "cpu", "writes_per_sec": 18.6,
                       "write_p50_s": 0.42},
@@ -37,31 +37,31 @@ def test_extracts_both_formats():
         "kernel": {"backend": "tpu", "rsa2048_verifies_per_sec": 5e5},
         "bad": {"error": "boom"},
     }))
-    assert d["cluster_4"] == ("cpu", 18.6, 0.42, None, None)
-    assert d["cluster_shards"] == ("cpu", 55.0, None, None, None)
+    assert d["cluster_4"] == ("cpu", 18.6, 0.42, None, None, None)
+    assert d["cluster_shards"] == ("cpu", 55.0, None, None, None, None)
     assert d["kernel"][1] == 5e5
-    assert d["bad"] == ("err", None, None, None, None)
+    assert d["bad"] == ("err", None, None, None, None, None)
     # three-element compact form (driver records after the round collapse)
     d = extract_sections(driver_record({"cluster_4": ["cpu", 7.5, 0.3]}))
-    assert d["cluster_4"] == ("cpu", 7.5, 0.3, None, None)
+    assert d["cluster_4"] == ("cpu", 7.5, 0.3, None, None, None)
     # four-element compact form: the gray section's slowdown ratio
     d = extract_sections(
         driver_record({"cluster_4_gray": ["cpu", 20.0, 0.1, 1.8]})
     )
-    assert d["cluster_4_gray"] == ("cpu", 20.0, 0.1, 1.8, None)
+    assert d["cluster_4_gray"] == ("cpu", 20.0, 0.1, 1.8, None, None)
     d = extract_sections(detail_record({
         "cluster_4_gray": {"backend": "cpu", "writes_per_sec": 20.0,
                            "write_p50_s": 0.1,
                            "gray_slowdown_hedged": 1.7},
     }))
-    assert d["cluster_4_gray"] == ("cpu", 20.0, 0.1, 1.7, None)
+    assert d["cluster_4_gray"] == ("cpu", 20.0, 0.1, 1.7, None, None)
     # five-element compact form: phase_budget shares ride 5th (gray
     # slot null when the section has no gray axis)
     d = extract_sections(driver_record({
         "cluster_4": ["cpu", 60.0, 0.2, None, {"rpc": 0.6, "server": 0.3}],
     }))
     assert d["cluster_4"] == (
-        "cpu", 60.0, 0.2, None, {"rpc": 0.6, "server": 0.3}
+        "cpu", 60.0, 0.2, None, {"rpc": 0.6, "server": 0.3}, None
     )
     d = extract_sections(detail_record({
         "cluster_4": {"backend": "cpu", "writes_per_sec": 60.0,
@@ -69,6 +69,40 @@ def test_extracts_both_formats():
                       "phase_budget": {"rpc": 0.6}},
     }))
     assert d["cluster_4"][4] == {"rpc": 0.6}
+    # six-element compact form: the r11 device-plane occupancy axis
+    d = extract_sections(driver_record({
+        "cluster_sidecar": ["cpu/1", 590.0, None, None, None, 1024.0],
+    }))
+    assert d["cluster_sidecar"] == ("cpu/1", 590.0, None, None, None, 1024.0)
+    d = extract_sections(detail_record({
+        "cluster_sidecar": {
+            "backend": "cpu/1",
+            "sidecar_ops_per_sec": 590.0,
+            "megabatch_occupancy_items_per_launch": 1024.0,
+        },
+    }))
+    assert d["cluster_sidecar"][5] == 1024.0
+
+
+def test_occupancy_axis_reported_not_gated():
+    """The r11 occupancy axis informs the trajectory but never gates:
+    a collapse from 1024 to 2 items/launch is printed, not failed."""
+    old = driver_record(
+        {"cluster_sidecar": ["cpu/1", 590.0, None, None, None, 1024.0]}
+    )
+    new = driver_record(
+        {"cluster_sidecar": ["cpu/1", 580.0, None, None, None, 2.0]}
+    )
+    lines, regressions, compared = compare(old, new)
+    assert regressions == [] and compared == 1
+    assert any(
+        "occupancy" in ln and "report-only" in ln for ln in lines
+    )
+    # one-sided (old record predates the axis) still reports
+    old2 = driver_record({"cluster_sidecar": ["cpu/1", 590.0]})
+    lines, regressions, _ = compare(old2, new)
+    assert regressions == []
+    assert any("occupancy" in ln for ln in lines)
 
 
 def test_gray_slowdown_gated():
