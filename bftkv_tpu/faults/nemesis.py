@@ -203,10 +203,22 @@ class Nemesis:
         autopilot: bool = False,
         sidecar_ctl: SidecarHarness | None = None,
         rtt_spec: str | None = None,
+        workload: str | None = None,
     ):
         self.cluster = cluster
         self.seed = seed
         self.registry = registry or fp.registry
+        #: Spec-shaped traffic (``--workload``, DESIGN.md §23): each
+        #: window drains the next slice of ONE deterministic workload
+        #: op stream on top of the coverage burst, so faults land
+        #: under a production op mix (hot-set storms, ramps) instead
+        #: of only the hand-rolled burst.
+        self.workload = None
+        self._wl_cursor = 0
+        if workload:
+            from bftkv_tpu.workload.spec import parse_spec
+
+            self.workload = parse_spec(workload)
         #: WAN link-delay program (``--rtt-matrix``): compiled onto
         #: quiet background delay rules right after :meth:`run` arms
         #: the registry, so the whole schedule executes under the
@@ -633,6 +645,8 @@ class Nemesis:
                 )
         if self._gwc is not None:
             self._gateway_traffic(tag)
+        if self.workload is not None:
+            self._workload_traffic(writes + reads)
         # str seeds hash via sha512 (deterministic); a tuple seed would
         # go through PYTHONHASHSEED-salted hash() and break replay.
         rng = random.Random(f"{self.seed}|{tag}")
@@ -693,6 +707,70 @@ class Nemesis:
             except Exception as e:
                 rec.read_fail(cname, rv, e)
                 self.failures["read"] += 1
+
+    def _workload_traffic(self, n: int) -> None:
+        """Drain the next ``n`` ops of the ``--workload`` spec stream
+        through the recorded-traffic plane.  The stream position
+        advances monotonically across windows — op ``g`` is always op
+        ``g``, so one seed replays one schedule regardless of window
+        count or fault outcome.  Writes are recorded for the checker;
+        reads of never-written ranks execute but stay unrecorded (a
+        quorum miss carries no invariant).  TOFU holds by construction:
+        owner slot ``o`` is always written by client ``o % clients``."""
+        spec = self.workload
+        rec = self.cluster.recorder
+        clients = self.cluster.clients
+        for g in range(self._wl_cursor, self._wl_cursor + n):
+            op = spec.op_at(g)
+            idx = op.owner % len(clients)
+            cl = clients[idx]
+            cname = f"u{idx + 1:02d}"
+            var = spec.key_bytes(op.owner, op.rank)
+            if op.kind == "write":
+                val = (b"wl-%d" % g).ljust(min(op.size, 1024), b".")
+                self._write_one(cl, rec, cname, var, val)
+            elif op.kind == "write_many":
+                val = (b"wlm-%d" % g).ljust(min(op.size, 1024), b".")
+                items = [
+                    (spec.key_bytes(op.owner, op.rank + j), val)
+                    for j in range(min(spec.wm_batch, 4))
+                ]
+                try:
+                    res = cl.write_many(items)
+                except Exception as e:
+                    res = [e] * len(items)
+                for (v, vv), err in zip(items, res):
+                    if err is None:
+                        rec.write_ok(cname, v, vv)
+                        self._written[v] = vv
+                    else:
+                        rec.write_fail(cname, v, err)
+                        self.failures["write"] += 1
+            elif op.kind == "scan":
+                keys = [
+                    spec.key_bytes(op.owner, op.rank + j)
+                    for j in range(min(spec.scan_width, spec.keyspace))
+                ]
+                try:
+                    cl.read_many(keys)
+                except Exception:
+                    self.failures["read"] += 1
+            else:  # read | gateway_read (degrades without gateways)
+                rdr = (
+                    self._gwc
+                    if op.kind == "gateway_read" and self._gwc is not None
+                    else cl
+                )
+                rname = "gw" if rdr is self._gwc else cname
+                try:
+                    got = rdr.read(var)
+                    if var in self._written:
+                        rec.read_ok(rname, var, got)
+                except Exception as e:
+                    if var in self._written:
+                        rec.read_fail(rname, var, e)
+                    self.failures["read"] += 1
+        self._wl_cursor += n
 
     def _write_one(self, cl, rec, cname: str, var: bytes, val: bytes) -> None:
         try:
@@ -1390,6 +1468,14 @@ class Nemesis:
                 self.wan[0].describe() if self.wan else None
             ),
             "route_epoch": epoch_after,
+            "workload": (
+                {
+                    "spec": self.workload.canonical(),
+                    "ops_drained": self._wl_cursor,
+                }
+                if self.workload is not None
+                else None
+            ),
             "autopilot": autopilot_doc,
             "plan": plan,
             "converged": converged,
@@ -1460,6 +1546,15 @@ def main(argv: list[str] | None = None) -> int:
                          "transport.send delay rules so the whole "
                          "schedule runs under deployment geography; "
                          "needs --regions (default: BFTKV_WAN_RTT_MATRIX)")
+    ap.add_argument("--workload",
+                    default=flags.raw("BFTKV_WORKLOAD") or "",
+                    help="drive spec-shaped traffic through every "
+                         "window on top of the coverage burst: a "
+                         "workload spec `preset[,k=v,...]` "
+                         "(bftkv_tpu/workload/spec.py, e.g. "
+                         "`storm,seed=7`); the op stream position "
+                         "advances across windows so one seed replays "
+                         "one schedule (default: BFTKV_WORKLOAD)")
     ap.add_argument("--bits", type=int, default=1024)
     ap.add_argument("--dwell", type=float, default=0.0,
                     help="extra seconds to hold each fault window open")
@@ -1512,6 +1607,13 @@ def main(argv: list[str] | None = None) -> int:
         ap.error("--kinds region_partition needs --regions 2+")
     if args.rtt_matrix and args.regions < 2:
         ap.error("--rtt-matrix needs --regions 2+")
+    if args.workload:
+        from bftkv_tpu.workload.spec import parse_spec
+
+        try:
+            parse_spec(args.workload)
+        except ValueError as e:
+            ap.error(f"--workload: {e}")
 
     # The sidecar's dispatchers are process-global, so it arms BEFORE
     # the cluster boots: every server's share issuance and collective
@@ -1546,6 +1648,7 @@ def main(argv: list[str] | None = None) -> int:
         report = Nemesis(
             cluster, seed=args.seed, autopilot=args.autopilot,
             sidecar_ctl=sidecar_ctl, rtt_spec=args.rtt_matrix or None,
+            workload=args.workload or None,
         ).run(
             steps=args.steps, dwell=args.dwell,
             detect=not args.no_detect, kinds=kinds,
@@ -1572,6 +1675,12 @@ def main(argv: list[str] | None = None) -> int:
     lockwatch_msg = (
         lockwatch.fail_message() if lockwatch.enabled() else None
     )
+    # Workload-armed oracle (DESIGN.md §23): spec-shaped traffic must
+    # degrade under faults, never fail.  Coverage-only runs keep the
+    # historical count-don't-raise behavior.
+    workload_failed_writes = (
+        report["failures"]["write"] if report.get("workload") else 0
+    )
     failed = bool(
         report["violations"]
         or not report["converged"]
@@ -1580,6 +1689,7 @@ def main(argv: list[str] | None = None) -> int:
         or report["sidecar_blocked"]
         or report["region_blocked"]
         or report["recorder_missing"]
+        or workload_failed_writes
         or lockwatch_msg
     )
     if args.json:
@@ -1666,6 +1776,13 @@ def main(argv: list[str] | None = None) -> int:
         return 1
     if report["recorder_missing"]:
         print("nemesis: FAULT WINDOWS WITHOUT A FLIGHT-RECORDER BUNDLE")
+        return 1
+    if workload_failed_writes:
+        print(
+            f"nemesis: WORKLOAD WRITES FAILED "
+            f"({workload_failed_writes}) — spec-shaped load must "
+            f"degrade under faults, never fail"
+        )
         return 1
     if lockwatch_msg:
         print(lockwatch_msg)

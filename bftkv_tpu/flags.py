@@ -361,6 +361,24 @@ _flag("BFTKV_GIL_SAMPLER", "1", "switch",
       "GIL-pressure estimate (runnable-thread gauge) riding the "
       "profiler tick; costs nothing while the profiler is disarmed.")
 
+_begin("Workload engine")
+_flag("BFTKV_WORKLOAD", None, "str",
+      "Workload spec `preset[,k=v,...]` (bftkv_tpu/workload/spec.py) "
+      "for spec-shaped traffic: the chaos nemesis `--workload` default "
+      "(unset: coverage traffic only).")
+_flag("BFTKV_WORKLOAD_SEED", None, "int",
+      "Seed override for workload-driven bench sections; one seed "
+      "replays one op stream bit-for-bit (unset: section default).")
+_flag("BFTKV_WORKLOAD_RATE", None, "float",
+      "Offered-load override in ops/s for bench cluster_workload and "
+      "cluster_shards (unset: section defaults).")
+_flag("BFTKV_WORKLOAD_DURATION", None, "float",
+      "Per-preset schedule duration override in seconds for bench "
+      "cluster_workload (unset: section default).")
+_flag("BFTKV_WORKLOAD_PROCS", None, "int",
+      "Worker-process count for the multi-process driver pair in bench "
+      "cluster_workload (unset: 2).")
+
 # ---------------------------------------------------------------------------
 # The read seam.
 # ---------------------------------------------------------------------------

@@ -444,8 +444,14 @@ def test_sidecar_first_launch_triggers_recalibration(tmp_path, monkeypatch):
         metrics.reset()
         dispatch.note_launch_rtt(0.010)  # "a launch completed"
         deadline = threading.Event()
-        for _ in range(100):
-            if metrics.snapshot().get("sidecar.recalibrations", 0) >= 1:
+        # Wait on THIS service's first-launch latch, not the bare
+        # counter: a predecessor test's recal thread can outlive its
+        # stop() join timeout and bump the global counter after our
+        # metrics.reset(), satisfying a counter-only wait early.
+        for _ in range(200):
+            if (srv.service._recal_seen_rtt
+                    and metrics.snapshot().get(
+                        "sidecar.recalibrations", 0) >= 1):
                 break
             deadline.wait(0.05)
         assert metrics.snapshot().get("sidecar.recalibrations", 0) >= 1

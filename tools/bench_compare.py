@@ -43,7 +43,9 @@ __all__ = [
     "compare",
     "extract_sections",
     "main",
+    "BASELINE_RESET",
     "GRAY_SLOWDOWN_MAX",
+    "P50_REPORT_ONLY",
     "REPORT_ONLY",
 ]
 
@@ -52,17 +54,37 @@ __all__ = [
 #: cluster_sidecar precedent) and gates now that r10 shares it — the
 #: promotion the one-round grace period promised.
 #:
-#: cluster_shards re-enters at r11 for a different reason: measured
-#: box noise, not a first landing.  The section's rate comes from a
-#: sub-second 48-write burst, and on the 1-core driver box the SAME
-#: code (r11 HEAD with the device plane both on and off, and the r10
-#: commit re-measured) sampled 45–126 w/s across eleven back-to-back
-#: runs — a 2.8x spread that swallows the 30% gate.  r10's committed
-#: 148.45 is an upper-tail draw from a quieter hour, so gating r11
-#: against it fails builds on weather.  The section now writes 3x the
-#: burst (see bench.py) so a future steadier round can promote it
-#: back, exactly like cluster_4_log's round-trip through this set.
-REPORT_ONLY: set = {"cluster_shards"}
+#: cluster_shards sat here r11 for measured box noise (sub-second
+#: closed-loop burst, 45–126 w/s across same-code runs on the 1-core
+#: driver box) and promoted back out at r12 via BASELINE_RESET below.
+#:
+#: cluster_workload rides here for its FIRST landing round (r12), the
+#: cluster_4_gray / cluster_sidecar / cluster_4_log precedent; it
+#: gates once the next round shares it.
+REPORT_ONLY: set = {"cluster_workload"}
+
+#: Sections whose headline METRIC changed semantics at a given round:
+#: comparisons that straddle the reset round are reported, never gated
+#: (the numbers measure different things), and comparisons entirely on
+#: one side gate as usual.  cluster_shards at r12: the measured region
+#: moved from a closed-loop burst (how fast CAN the box write — the
+#: noise that demoted it in r11) to a FIXED OFFERED LOAD through the
+#: workload engine, so the recorded rate is the achieved rate against
+#: a deterministic schedule — stable by construction, with queueing in
+#: the CO-corrected p99_offered_s — and r12→r13 gates on it.  Keyed by
+#: the driver records' round number ``n``; detail records carry no
+#: round number, so ad-hoc detail diffs compare as before.
+BASELINE_RESET: dict = {"cluster_shards": 12}
+
+#: Sections whose write-p50 ROUND-RATIO is reported, never gated.
+#: cluster_4_gray's p50 is dominated by hedge-delay scheduling against
+#: crypto contention: back-to-back same-code runs on the 1-core driver
+#: box drew 0.119–0.203 s (1.7x spread), so the 30% ratio gate fails
+#: on weather about every other round.  The section's latency CONTRACT
+#: is the absolute §13 bound — hedged p50 ≤ 2x the fault-free floor —
+#: which rides the 4th slot and still gates on every round, weather or
+#: not.  Throughput still gates normally.
+P50_REPORT_ONLY: set = {"cluster_4_gray"}
 
 #: Absolute bound on the NEW record's hedged gray slowdown (write p50
 #: with one delayed clique member ÷ fault-free floor) — the DESIGN.md
@@ -176,6 +198,8 @@ def compare(
     silently stops gating."""
     a = extract_sections(old)
     b = extract_sections(new)
+    n_old = old.get("n") if isinstance(old, dict) else None
+    n_new = new.get("n") if isinstance(new, dict) else None
     lines: list[str] = []
     regressions: list[str] = []
     compared = 0
@@ -190,6 +214,20 @@ def compare(
             lines.append(
                 f"  {name}: {va} -> {vb}  (report-only, not gated)"
             )
+            continue
+        reset = BASELINE_RESET.get(name)
+        if (
+            reset is not None
+            and isinstance(n_old, int)
+            and isinstance(n_new, int)
+            and n_old < reset <= n_new
+        ):
+            lines.append(
+                f"  {name}: {va} -> {vb}  (metric semantics reset at "
+                f"r{reset:02d}, baselines incommensurable — not "
+                f"compared; gates again next round)"
+            )
+            compared += 1  # the gate engaged; the reset is visible
             continue
         if va is None or vb is None:
             lines.append(f"  {name}: no shared number "
@@ -215,14 +253,23 @@ def compare(
         # missing side must not fail every historical comparison.
         if pa is not None and pb is not None and pa > 0:
             lratio = pb / pa
-            lverdict = "ok"
-            if lratio > 1.0 + threshold:
-                lverdict = f"REGRESSION (p50 >{threshold:.0%} slower)"
-                regressions.append(f"{name} (write p50)")
-            lines.append(
-                f"  {name} write p50: {pa:g}s -> {pb:g}s  "
-                f"({lratio:.2f}x)  {lverdict}"
-            )
+            if name in P50_REPORT_ONLY:
+                lines.append(
+                    f"  {name} write p50: {pa:g}s -> {pb:g}s  "
+                    f"({lratio:.2f}x)  (report-only: gated by the "
+                    f"absolute {GRAY_SLOWDOWN_MAX:g}x hedge bound)"
+                )
+            else:
+                lverdict = "ok"
+                if lratio > 1.0 + threshold:
+                    lverdict = (
+                        f"REGRESSION (p50 >{threshold:.0%} slower)"
+                    )
+                    regressions.append(f"{name} (write p50)")
+                lines.append(
+                    f"  {name} write p50: {pa:g}s -> {pb:g}s  "
+                    f"({lratio:.2f}x)  {lverdict}"
+                )
         # Phase budget: the attribution plane's per-phase wall-clock
         # shares — reported so the committed trajectory shows WHERE
         # each round's latency went, never gated (shares shift with
